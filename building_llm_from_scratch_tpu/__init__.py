@@ -1,0 +1,9 @@
+"""building_llm_from_scratch_tpu — a TPU-native LLM training framework.
+
+A from-scratch JAX/XLA re-design targeting the full capability surface of
+the reference repo (chemphenoms/Building_LLM_from_scratch). See SURVEY.md
+for the component inventory and the per-module docstrings for what each
+subsystem provides.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,39 @@
+"""Model construction (reference: build_components.py:189-205).
+
+Every architecture is the shared transformer core plus a ``ModelConfig``;
+``build_model`` returns (config, params).
+"""
+
+from typing import Optional, Tuple
+
+import jax
+
+from building_llm_from_scratch_tpu.configs import ModelConfig, get_config
+from building_llm_from_scratch_tpu.models.transformer import (
+    forward,
+    forward_with_cache,
+    init_cache,
+    init_params,
+)
+
+__all__ = [
+    "build_model",
+    "forward",
+    "forward_with_cache",
+    "init_cache",
+    "init_params",
+]
+
+
+def build_model(model: str, num_params: str, key: Optional[jax.Array] = None,
+                **cfg_overrides) -> Tuple[ModelConfig, dict]:
+    """Instantiate (config, params) for a named model + size.
+
+    Mirrors the reference factory dispatch (build_components.py:198-205) where
+    each name maps to a different class; here it is one core + config lookup.
+    """
+    cfg = get_config(model, num_params, **cfg_overrides)
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    params = init_params(cfg, key)
+    return cfg, params

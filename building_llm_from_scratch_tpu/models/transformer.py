@@ -1,0 +1,339 @@
+"""The shared transformer core.
+
+ONE parameterized implementation covers every model family the reference
+builds with three separate module stacks:
+  - GPTModel          (reference Models/GPT2/GPT2.py:91-124)
+  - Llama2Model       (reference Models/Llama/Llama2.py:156-190)
+  - Llama3Model       (reference Models/Llama/Llama3.py:185-204)
+
+The architecture knobs live in ``ModelConfig`` (configs.py); the parameters
+are a plain pytree; the forward pass is a pure function usable under ``jit``
+/ ``pjit`` / ``grad`` / ``shard_map``.
+
+TPU-first design choices (vs. the reference's nn.Module stacks):
+  - all L transformer blocks are STACKED along a leading layer axis and
+    executed with ``jax.lax.scan`` — one compiled block body instead of L
+    unrolled copies (compile time O(1) in depth, XLA-friendly);
+  - ``--use_actv_ckpt`` maps to ``jax.checkpoint`` (remat) of the scanned
+    block body (reference: torch checkpoint_sequential, GPT2.py:115-116);
+  - no (ctx, ctx) causal-mask buffer; masking is positional iota inside the
+    attention kernel;
+  - KV-cache decode path with static shapes for jitted autoregressive
+    generation (the reference re-runs the full forward per token,
+    generate.py:36-45);
+  - dropout uses explicit PRNG keys, folded per layer.
+
+Parameter tree layout (linear weights stored (in, out), applied as x @ w):
+
+  params = {
+    "tok_emb":   {"weight": (V, D)},
+    "pos_emb":   {"weight": (T, D)}          # learned positions (GPT-2) only
+    "blocks": {
+      "norm1":   {"scale": (L, D)[, "bias": (L, D)]},
+      "attn":    {"wq": (L, D, Hq*hd), "wk": (L, D, Hkv*hd),
+                  "wv": (L, D, Hkv*hd), "wo": (L, Hq*hd, D)
+                  [, "bq", "bk", "bv" , "bo"]},
+      "norm2":   {"scale": (L, D)[, "bias"]},
+      "mlp":     {"up": (L, D, F), "down": (L, F, D)
+                  [, "gate": (L, D, F)]      # SwiGLU (LLaMA)
+                  [, "b_up": (L, F), "b_down": (L, D)]},
+    },
+    "final_norm": {"scale": (D,)[, "bias": (D,)]},
+    "head":      {"weight": (D, V)},
+  }
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from building_llm_from_scratch_tpu.configs import ModelConfig
+from building_llm_from_scratch_tpu.ops.attention import causal_attention
+from building_llm_from_scratch_tpu.ops.activations import gelu, silu
+from building_llm_from_scratch_tpu.ops.norms import layernorm, rmsnorm
+from building_llm_from_scratch_tpu.ops.rope import (
+    apply_rope,
+    precompute_rope_params,
+)
+
+Params = Dict[str, Any]
+
+
+# ---------------------------------------------------------------------------
+# Initialization
+# ---------------------------------------------------------------------------
+
+def _linear_init(key, in_dim: int, out_dim: int, dtype, n_layers=None):
+    """Truncated-normal fan-in init (GPT-2-style 0.02-capped)."""
+    std = min(0.02, in_dim ** -0.5)
+    shape = (in_dim, out_dim) if n_layers is None else (n_layers, in_dim, out_dim)
+    return (jax.random.truncated_normal(key, -3.0, 3.0, shape, jnp.float32)
+            * std).astype(dtype)
+
+
+def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
+    """Build the full parameter pytree for ``cfg``."""
+    L, D, V, T = cfg.n_layers, cfg.emb_dim, cfg.vocab_size, cfg.context_length
+    hd, Hq, Hkv, F = cfg.head_dim, cfg.n_heads, cfg.n_kv_groups, cfg.hidden_dim
+    dt = cfg.jax_dtype
+
+    keys = jax.random.split(key, 16)
+    zeros = lambda *shape: jnp.zeros(shape, dt)
+    ones = lambda *shape: jnp.ones(shape, dt)
+
+    attn: Params = {
+        "wq": _linear_init(keys[0], D, Hq * hd, dt, L),
+        "wk": _linear_init(keys[1], D, Hkv * hd, dt, L),
+        "wv": _linear_init(keys[2], D, Hkv * hd, dt, L),
+        "wo": _linear_init(keys[3], Hq * hd, D, dt, L),
+    }
+    if cfg.qkv_bias:
+        attn.update(bq=zeros(L, Hq * hd), bk=zeros(L, Hkv * hd),
+                    bv=zeros(L, Hkv * hd))
+    if cfg.attn_out_bias:
+        attn["bo"] = zeros(L, D)
+
+    mlp: Params = {
+        "up": _linear_init(keys[4], D, F, dt, L),
+        "down": _linear_init(keys[5], F, D, dt, L),
+    }
+    if cfg.activation == "swiglu":
+        mlp["gate"] = _linear_init(keys[6], D, F, dt, L)
+    if cfg.mlp_bias:
+        mlp.update(b_up=zeros(L, F), b_down=zeros(L, D))
+
+    def norm(n_layers=None):
+        n: Params = {"scale": ones(n_layers, D) if n_layers else ones(D)}
+        if cfg.norm_bias:
+            n["bias"] = zeros(n_layers, D) if n_layers else zeros(D)
+        return n
+
+    params: Params = {
+        "tok_emb": {"weight": (jax.random.normal(keys[7], (V, D), jnp.float32)
+                               * 0.02).astype(dt)},
+        "blocks": {"norm1": norm(L), "attn": attn, "norm2": norm(L), "mlp": mlp},
+        "final_norm": norm(),
+        "head": {"weight": _linear_init(keys[8], D, V, dt)},
+    }
+    if cfg.positional == "learned":
+        params["pos_emb"] = {"weight": (jax.random.normal(keys[9], (T, D),
+                                                          jnp.float32)
+                                        * 0.02).astype(dt)}
+    return params
+
+
+# ---------------------------------------------------------------------------
+# Building blocks
+# ---------------------------------------------------------------------------
+
+def _norm(cfg: ModelConfig, p: Params, x: jnp.ndarray) -> jnp.ndarray:
+    if cfg.norm == "rmsnorm":
+        return rmsnorm(x, p["scale"], eps=cfg.rmsnorm_eps)
+    return layernorm(x, p["scale"], p.get("bias"), eps=cfg.layernorm_eps)
+
+
+def _mlp(cfg: ModelConfig, p: Params, x: jnp.ndarray) -> jnp.ndarray:
+    if cfg.activation == "swiglu":
+        # silu(gate(x)) * up(x) -> down   (reference common_components.py:95-124)
+        g = x @ p["gate"]
+        u = x @ p["up"]
+        return (silu(g) * u) @ p["down"]
+    h = x @ p["up"]
+    if "b_up" in p:
+        h = h + p["b_up"]
+    h = gelu(h)
+    h = h @ p["down"]
+    if "b_down" in p:
+        h = h + p["b_down"]
+    return h
+
+
+def _dropout(x: jnp.ndarray, rate: float, rng: Optional[jax.Array],
+             deterministic: bool) -> jnp.ndarray:
+    if rate <= 0.0 or deterministic:
+        return x
+    keep = jax.random.bernoulli(rng, 1.0 - rate, x.shape)
+    return jnp.where(keep, x / (1.0 - rate), jnp.zeros_like(x))
+
+
+def _attention(cfg: ModelConfig, p: Params, x: jnp.ndarray,
+               rope: Optional[Tuple[jnp.ndarray, jnp.ndarray]],
+               positions: Optional[jnp.ndarray],
+               cache_kv: Optional[Tuple[jnp.ndarray, jnp.ndarray]],
+               cache_len: Optional[jnp.ndarray],
+               rng: Optional[jax.Array], deterministic: bool):
+    """Per-block attention; returns (out, new_cache_kv)."""
+    B, Tq, D = x.shape
+    hd, Hq, Hkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_groups
+
+    q = x @ p["wq"]
+    k = x @ p["wk"]
+    v = x @ p["wv"]
+    if "bq" in p:
+        q, k, v = q + p["bq"], k + p["bk"], v + p["bv"]
+    q = q.reshape(B, Tq, Hq, hd)
+    k = k.reshape(B, Tq, Hkv, hd)
+    v = v.reshape(B, Tq, Hkv, hd)
+
+    if rope is not None:
+        cos, sin = rope
+        q = apply_rope(q, cos, sin, positions)
+        k = apply_rope(k, cos, sin, positions)
+
+    new_cache = None
+    if cache_kv is not None:
+        # write current k/v into the cache at offset cache_len, attend to the
+        # full valid prefix
+        ck, cv = cache_kv                        # (B, Tmax, Hkv, hd)
+        ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype),
+                                          (0, cache_len, 0, 0))
+        cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype),
+                                          (0, cache_len, 0, 0))
+        new_cache = (ck, cv)
+        k, v = ck, cv
+        kv_length = cache_len + Tq
+        q_positions = positions
+    else:
+        kv_length = None
+        q_positions = None
+
+    out = causal_attention(
+        q, k, v,
+        q_positions=q_positions,
+        kv_length=kv_length,
+        dropout_rate=cfg.drop_rate,
+        dropout_rng=rng,
+        deterministic=deterministic,
+        impl=cfg.attn_impl,
+    )
+    out = out.reshape(B, Tq, Hq * hd) @ p["wo"]
+    if "bo" in p:
+        out = out + p["bo"]
+    return out, new_cache
+
+
+def _block(cfg: ModelConfig, p: Params, x: jnp.ndarray,
+           rope, positions, cache_kv, cache_len, rng, deterministic):
+    """Pre-norm transformer block (reference GPT2.py:68-88, Llama3.py:159-181)."""
+    if rng is not None:
+        r_attn, r_res1, r_res2 = jax.random.split(rng, 3)
+    else:
+        r_attn = r_res1 = r_res2 = None
+    h, new_cache = _attention(cfg, p["attn"], _norm(cfg, p["norm1"], x),
+                              rope, positions, cache_kv, cache_len,
+                              r_attn, deterministic)
+    x = x + _dropout(h, cfg.drop_rate, r_res1, deterministic)
+    h = _mlp(cfg, p["mlp"], _norm(cfg, p["norm2"], x))
+    x = x + _dropout(h, cfg.drop_rate, r_res2, deterministic)
+    return x, new_cache
+
+
+# ---------------------------------------------------------------------------
+# Forward passes
+# ---------------------------------------------------------------------------
+
+def _rope_tables(cfg: ModelConfig):
+    if not cfg.uses_rope:
+        return None
+    return precompute_rope_params(
+        cfg.head_dim,
+        theta_base=cfg.rope_base,
+        context_length=cfg.context_length,
+        rope_scaling=cfg.rope_scaling,
+    )
+
+
+def _embed(cfg: ModelConfig, params: Params, tokens: jnp.ndarray,
+           positions: Optional[jnp.ndarray], rng, deterministic) -> jnp.ndarray:
+    x = jnp.take(params["tok_emb"]["weight"], tokens, axis=0)
+    if cfg.positional == "learned":
+        T = tokens.shape[1]
+        pos = positions if positions is not None else jnp.arange(T)
+        x = x + jnp.take(params["pos_emb"]["weight"], pos, axis=0)
+    return _dropout(x, cfg.drop_rate, rng, deterministic)
+
+
+def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray, *,
+            rng: Optional[jax.Array] = None,
+            deterministic: bool = True) -> jnp.ndarray:
+    """Training/eval forward over full sequences.
+
+    tokens: (B, T) int32.  Returns fp32 logits (B, T, V).
+    """
+    L = cfg.n_layers
+    rope = _rope_tables(cfg)
+    if rng is None:
+        emb_rng = None
+        layer_rngs = jnp.zeros((L, 2), jnp.uint32)
+        deterministic = True
+    else:
+        emb_rng, blocks_rng = jax.random.split(rng)
+        layer_rngs = jax.random.split(blocks_rng, L)
+
+    x = _embed(cfg, params, tokens, None, emb_rng, deterministic)
+
+    def body(carry, layer):
+        p, lrng = layer
+        r = None if deterministic else lrng
+        y, _ = _block(cfg, p, carry, rope, None, None, None, r, deterministic)
+        return y, None
+
+    if cfg.use_actv_ckpt:
+        body = jax.checkpoint(body, prevent_cse=False)
+
+    x, _ = jax.lax.scan(body, x, (params["blocks"], layer_rngs))
+    x = _norm(cfg, params["final_norm"], x)
+    logits = jnp.einsum("btd,dv->btv", x, params["head"]["weight"],
+                        preferred_element_type=jnp.float32)
+    return logits
+
+
+# ---------------------------------------------------------------------------
+# KV-cache decode path
+# ---------------------------------------------------------------------------
+
+def init_cache(cfg: ModelConfig, batch_size: int, max_length: int) -> Params:
+    """Allocate a static-shape KV cache: (L, B, Tmax, Hkv, hd) per k/v."""
+    shape = (cfg.n_layers, batch_size, max_length, cfg.n_kv_groups, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, cfg.jax_dtype),
+        "v": jnp.zeros(shape, cfg.jax_dtype),
+        "length": jnp.zeros((), jnp.int32),
+    }
+
+
+def forward_with_cache(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
+                       cache: Params) -> Tuple[jnp.ndarray, Params]:
+    """Decode forward: process ``tokens`` (B, Tq) given ``cache`` holding
+    ``cache['length']`` valid positions; returns (fp32 logits (B, Tq, V),
+    updated cache). Static shapes throughout — jit-friendly.
+
+    Contract: the caller must ensure ``cache['length'] + Tq <= max_length``
+    (the cache allocation). Under jit an overflow cannot raise —
+    ``dynamic_update_slice`` would clamp the write offset and silently
+    overwrite the newest entries. The generation loop sizes its cache to
+    ``prompt_len + max_new_tokens`` so this never triggers.
+    """
+    rope = _rope_tables(cfg)
+    length = cache["length"]
+    Tq = tokens.shape[1]
+    positions = length + jnp.arange(Tq)
+
+    x = _embed(cfg, params, tokens, positions, None, True)
+
+    def body(carry, layer):
+        p, ck, cv = layer
+        y, new_kv = _block(cfg, p, carry, rope, positions, (ck, cv), length,
+                           None, True)
+        return y, new_kv
+
+    x, (new_k, new_v) = jax.lax.scan(body, x,
+                                     (params["blocks"], cache["k"], cache["v"]))
+    x = _norm(cfg, params["final_norm"], x)
+    logits = jnp.einsum("btd,dv->btv", x, params["head"]["weight"],
+                        preferred_element_type=jnp.float32)
+    new_cache = {"k": new_k, "v": new_v, "length": length + Tq}
+    return logits, new_cache

@@ -1,0 +1,20 @@
+"""Numerical ops shared by all models (reference: Models/Llama/common_components.py,
+the attention bodies of Models/GPT2/GPT2.py and Models/Llama/Llama3.py)."""
+
+from building_llm_from_scratch_tpu.ops.norms import layernorm, rmsnorm
+from building_llm_from_scratch_tpu.ops.activations import gelu, silu
+from building_llm_from_scratch_tpu.ops.rope import (
+    precompute_rope_params,
+    apply_rope,
+)
+from building_llm_from_scratch_tpu.ops.attention import causal_attention
+
+__all__ = [
+    "layernorm",
+    "rmsnorm",
+    "gelu",
+    "silu",
+    "precompute_rope_params",
+    "apply_rope",
+    "causal_attention",
+]

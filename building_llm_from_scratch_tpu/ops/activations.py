@@ -1,0 +1,22 @@
+"""Activations.
+
+GELU (tanh approximation, matching torch.nn.GELU's default erf variant closely
+enough for training; we use the exact erf form since XLA fuses it fine) and
+SiLU (reference hand-writes it, common_components.py:78-88).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def gelu(x: jnp.ndarray) -> jnp.ndarray:
+    # Exact erf GELU — same as torch.nn.GELU() used by the reference GPT-2 MLP
+    # (Models/GPT2/GPT2.py:52-65).
+    return jax.nn.gelu(x, approximate=False)
+
+
+def silu(x: jnp.ndarray) -> jnp.ndarray:
+    # x * sigmoid(x) (reference common_components.py:78-88).
+    return x * jax.nn.sigmoid(x)

@@ -1,0 +1,86 @@
+"""Causal (grouped-query) attention.
+
+One implementation replaces the reference's three attention classes:
+  - MultiHeadAttention        (Models/GPT2/GPT2.py:6-49)
+  - MHA w/ RoPE               (Models/Llama/Llama2.py:61-114)
+  - GroupedQueryAttention     (Models/Llama/Llama3.py:108-155)
+
+TPU-first differences:
+  - no (ctx, ctx) mask *buffer*: the causal mask is generated from position
+    iota inside the kernel, so context length is not memory-bound by a
+    persistent O(T^2) tensor;
+  - KV heads are expanded by broadcasting inside the einsum (the reference
+    materializes ``repeat_interleave`` copies, Llama3.py:133-137);
+  - softmax runs in fp32 and the matmuls carry
+    ``preferred_element_type=float32`` so bf16 training is stable on the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def causal_attention(
+    q: jnp.ndarray,               # (B, Tq, Hq, D)
+    k: jnp.ndarray,               # (B, Tkv, Hkv, D)
+    v: jnp.ndarray,               # (B, Tkv, Hkv, D)
+    *,
+    q_positions: Optional[jnp.ndarray] = None,   # (Tq,) or (B, Tq) absolute pos
+    kv_length: Optional[jnp.ndarray] = None,     # scalar or (B,): valid kv prefix
+    dropout_rate: float = 0.0,
+    dropout_rng: Optional[jax.Array] = None,
+    deterministic: bool = True,
+    impl: str = "auto",
+) -> jnp.ndarray:
+    """Scaled dot-product attention with causal masking and GQA.
+
+    For training, call with q=k=v lengths equal and no kv_length. For
+    cached decode, pass the full cache as k/v, absolute ``q_positions`` and
+    ``kv_length`` = number of valid cache entries.
+    """
+    B, Tq, Hq, D = q.shape
+    _, Tkv, Hkv, _ = k.shape
+    assert Hq % Hkv == 0, "query heads must be a multiple of kv heads"
+    G = Hq // Hkv
+
+    if impl not in ("auto", "xla"):
+        raise NotImplementedError(
+            f"attention impl '{impl}' is not available yet; use 'auto'/'xla'")
+
+    if q_positions is None:
+        # training path: q and kv are the same sequence
+        q_pos = jnp.arange(Tq)
+    else:
+        q_pos = q_positions
+    kv_pos = jnp.arange(Tkv)
+
+    if q_pos.ndim == 1:
+        mask = q_pos[:, None] >= kv_pos[None, :]            # (Tq, Tkv)
+        mask = mask[None, None, None, :, :]                 # (1,1,1,Tq,Tkv)
+    else:
+        mask = q_pos[:, :, None] >= kv_pos[None, None, :]   # (B, Tq, Tkv)
+        mask = mask[:, None, None, :, :]                    # (B,1,1,Tq,Tkv)
+    if kv_length is not None:
+        valid = kv_pos[None, :] < jnp.reshape(kv_length, (-1, 1))  # (B|1, Tkv)
+        mask = mask & valid[:, None, None, None, :]
+
+    qg = q.reshape(B, Tq, Hkv, G, D)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(D, dtype=jnp.float32))
+    # (B, Hkv, G, Tq, Tkv) in fp32 for a stable softmax
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores * scale
+    scores = jnp.where(mask, scores, jnp.asarray(-1e30, dtype=scores.dtype))
+    weights = jax.nn.softmax(scores, axis=-1)
+
+    if dropout_rate > 0.0 and not deterministic:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate,
+                                    weights.shape)
+        weights = jnp.where(keep, weights / (1.0 - dropout_rate), 0.0)
+
+    weights = weights.astype(v.dtype)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", weights, v)
+    return out.reshape(B, Tq, Hq, D)

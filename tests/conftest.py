@@ -1,0 +1,37 @@
+"""Test configuration: force an 8-device virtual CPU platform.
+
+This is the TPU-world analog of a fake distributed backend (SURVEY.md §4):
+all sharding/collective tests run on 8 virtual CPU devices via
+``--xla_force_host_platform_device_count``.
+
+Note: this environment's sitecustomize registers a TPU PJRT plugin and pins
+``JAX_PLATFORMS=axon`` at interpreter startup, so plain env vars are not
+enough — we must flip ``jax_platforms`` via jax.config after import (backends
+initialize lazily, so the XLA_FLAGS below still take effect).
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng_key():
+    return jax.random.PRNGKey(0)
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _assert_cpu_backend():
+    assert jax.default_backend() == "cpu"
+    assert len(jax.devices()) == 8
+    yield
