@@ -1,0 +1,37 @@
+"""Host-side data pipeline (reference: datautils/)."""
+
+from building_llm_from_scratch_tpu.data.tokenizers import (
+    ByteTokenizer,
+    GPT2Tokenizer,
+    Llama2Tokenizer,
+    Llama3Tokenizer,
+    build_tokenizer,
+)
+from building_llm_from_scratch_tpu.data.pretrain import (
+    PretrainDataset,
+    PretrainLoader,
+    make_windows,
+)
+from building_llm_from_scratch_tpu.data.instruct import (
+    InstructionDataset,
+    InstructLoader,
+    collate_batch,
+    format_input,
+    format_input_phi,
+)
+
+__all__ = [
+    "ByteTokenizer",
+    "GPT2Tokenizer",
+    "Llama2Tokenizer",
+    "Llama3Tokenizer",
+    "build_tokenizer",
+    "PretrainDataset",
+    "PretrainLoader",
+    "make_windows",
+    "InstructionDataset",
+    "InstructLoader",
+    "collate_batch",
+    "format_input",
+    "format_input_phi",
+]

@@ -1,0 +1,166 @@
+"""Instruction-finetuning data pipeline (Alpaca format) with loss masking.
+
+Parity with the reference:
+  - Alpaca prompt template                 (datautils/dataset_instruction_finetune.py:6-25)
+  - Phi-style template variant             (:28-42)
+  - pre-tokenized prompt+response with
+    recorded instruction length            (:45-76)
+  - collator: append eos, pad, shift,
+    mask all-but-first pad and the
+    instruction prefix with ignore_index   (datautils/dataloader_instruction_finetune.py:10-50)
+
+TPU-first difference: instead of emitting -100 sentinel targets for a
+dynamic batch_max_length (a new XLA program per batch shape), we emit
+fixed-shape (B, max_length) inputs/targets plus a float ``loss_weight`` mask
+(1.0 where the reference would supervise, 0.0 where it writes -100). A
+weighted-mean cross entropy over these weights is mathematically identical
+to torch F.cross_entropy's default mean over non-ignored positions.
+
+The reference's pad-id defect (§2.3 #8: hardcoded GPT-2 eos 50256 even for
+LLaMA) is fixed by taking pad/eos ids from the model config.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def format_input(entry: Dict[str, str]) -> str:
+    """Alpaca prompt template (reference dataset_instruction_finetune.py:6-25)."""
+    instruction_text = (
+        "Below is an instruction that describes a task. "
+        "Write a response that appropriately completes the request."
+        f"\n\n### Instruction:\n{entry['instruction']}"
+    )
+    input_text = f"\n\n### Input:\n{entry['input']}" if entry.get("input") else ""
+    return instruction_text + input_text
+
+
+def format_input_phi(entry: Dict[str, str]) -> str:
+    """Phi-style template (reference dataset_instruction_finetune.py:28-42)."""
+    instruction_text = f"<|user|>\n{entry['instruction']}"
+    input_text = f"\n{entry['input']}" if entry.get("input") else ""
+    return instruction_text + input_text
+
+
+class InstructionDataset:
+    """Pre-tokenize prompt+response per record, remembering the prompt length
+    so the collator can mask it (reference dataset_instruction_finetune.py:45-76).
+    """
+
+    def __init__(self, data: Sequence[Dict[str, str]], tokenizer,
+                 style: str = "alpaca"):
+        fmt = format_input if style == "alpaca" else format_input_phi
+        resp_prefix = ("\n\n### Response:\n" if style == "alpaca"
+                       else "\n<|assistant|>:\n")
+        self.data = list(data)
+        self.encoded_texts: List[List[int]] = []
+        self.instruction_lengths: List[int] = []
+        for entry in self.data:
+            prompt = fmt(entry)
+            full_text = prompt + resp_prefix + entry["output"]
+            self.encoded_texts.append(tokenizer.encode(full_text))
+            self.instruction_lengths.append(len(tokenizer.encode(prompt)))
+
+    def __getitem__(self, index: int) -> Tuple[int, List[int]]:
+        return self.instruction_lengths[index], self.encoded_texts[index]
+
+    def __len__(self) -> int:
+        return len(self.data)
+
+
+def collate_batch(batch: Sequence[Tuple[int, List[int]]], *,
+                  pad_token_id: int, allowed_max_length: int
+                  ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Fixed-shape collate: (inputs, targets, loss_weight), each
+    (B, allowed_max_length).
+
+    Semantics per row (reference dataloader_instruction_finetune.py:21-50):
+      seq     = tokens + [eos-as-pad]; then pad to a fixed length
+      inputs  = seq[:-1]; targets = seq[1:]
+      weights = 0 where targets is padding (except the FIRST pad, which
+                supervises the eos), 0 over the instruction prefix
+                (targets[:instr_len-1]), 1 elsewhere; rows truncate to
+                allowed_max_length.
+    """
+    T = allowed_max_length
+    B = len(batch)
+    inputs = np.full((B, T), pad_token_id, np.int32)
+    targets = np.full((B, T), pad_token_id, np.int32)
+    weights = np.zeros((B, T), np.float32)
+    for i, (instr_len, item) in enumerate(batch):
+        seq = list(item) + [pad_token_id]
+        # pad to T+1 so inputs/targets both reach length T after shifting
+        seq = (seq + [pad_token_id] * (T + 1 - len(seq)))[: T + 1]
+        row_in = np.asarray(seq[:-1], np.int32)
+        row_tg = np.asarray(seq[1:], np.int32)
+        w = np.ones(T, np.float32)
+        pad_pos = np.nonzero(row_tg == pad_token_id)[0]
+        # mask all pad targets except the first (the supervised eos) —
+        # note: like the reference, this also masks genuine in-sequence
+        # occurrences of the pad id beyond the first
+        if len(pad_pos) > 1:
+            w[pad_pos[1:]] = 0.0
+        w[: max(0, instr_len - 1)] = 0.0
+        inputs[i], targets[i], weights[i] = row_in, row_tg, w
+    return inputs, targets, weights
+
+
+class InstructLoader:
+    """Loader for instruction finetuning (reference DataloaderIF,
+    dataloader_instruction_finetune.py:53-134): 90/10 record split, shuffled
+    fixed-shape batches, per-process sharding."""
+
+    def __init__(self, tokenizer, batch_size: int, max_length: int,
+                 pad_token_id: int, dataset_name: str = "alpaca",
+                 train_ratio: float = 0.90, process_index: int = 0,
+                 process_count: int = 1, seed: int = 123):
+        if dataset_name.lower() not in ("alpaca",):
+            raise ValueError(f"Dataset '{dataset_name}' is not supported.")
+        self.tokenizer = tokenizer
+        self.batch_size = batch_size
+        self.max_length = max_length
+        self.pad_token_id = pad_token_id
+        self.train_ratio = train_ratio
+        self.process_index = process_index
+        self.process_count = process_count
+        self.seed = seed
+
+    def create_datasets(self, records: Sequence[Dict[str, str]]
+                        ) -> Tuple[InstructionDataset, InstructionDataset]:
+        split = int(self.train_ratio * len(records))
+        return (InstructionDataset(records[:split], self.tokenizer),
+                InstructionDataset(records[split:], self.tokenizer))
+
+    def batches(self, dataset: InstructionDataset, *, shuffle: bool = True,
+                epoch: int = 0
+                ) -> Iterator[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+        n = len(dataset)
+        order = np.arange(n)
+        if shuffle:
+            rng = np.random.default_rng(self.seed + epoch)
+            rng.shuffle(order)
+        global_bs = self.batch_size * self.process_count
+        for b in range(n // global_bs):
+            sl = order[b * global_bs:(b + 1) * global_bs]
+            mine = sl[self.process_index::self.process_count]
+            yield collate_batch([dataset[j] for j in mine],
+                                pad_token_id=self.pad_token_id,
+                                allowed_max_length=self.max_length)
+
+    def num_batches(self, dataset: InstructionDataset) -> int:
+        return len(dataset) // (self.batch_size * self.process_count)
+
+    def get_total_steps_epoch(self, files: List[str], read_fn=None) -> int:
+        """Reference dataloader_instruction_finetune.py:123-134."""
+        from building_llm_from_scratch_tpu.utils.io import read_json_file
+
+        read_fn = read_fn or read_json_file
+        total = 0
+        for path in files:
+            records = read_fn(path)
+            train, _ = self.create_datasets(records)
+            total += self.num_batches(train)
+        return total

@@ -1,0 +1,131 @@
+"""Pretraining data pipeline: sliding-window causal-LM batches.
+
+Parity with the reference:
+  - tokenize whole text once w/ eos allowed   (datautils/dataset.py:26)
+  - sliding windows of max_length w/ stride,
+    targets = inputs shifted by one           (datautils/dataset.py:29-34)
+  - 90/10 char-level train/val split          (datautils/dataloader.py:66-85)
+  - per-epoch reshuffle (set_epoch analog)    (train.py:169-170)
+  - total-steps pre-pass over all files       (datautils/dataloader.py:87-103)
+
+TPU-first differences: batches are fixed-shape numpy arrays (drop_last
+always, so every jit'd step sees one shape); sharding across data-parallel
+processes is an index stride over the global batch stream (replacing torch's
+DistributedSampler), handled by the caller via ``process_index``/
+``process_count``.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+
+def make_windows(token_ids: np.ndarray, max_length: int,
+                 stride: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Materialize sliding windows: inputs (N, T) and shifted targets (N, T).
+
+    Reference: datautils/dataset.py:29-34 (windows of ``max_length`` every
+    ``stride`` tokens; partial trailing windows dropped).
+    """
+    token_ids = np.asarray(token_ids, dtype=np.int32)
+    n = len(token_ids) - max_length          # need max_length+1 tokens per row
+    if n <= 0:
+        return (np.zeros((0, max_length), np.int32),
+                np.zeros((0, max_length), np.int32))
+    starts = np.arange(0, n, stride)
+    idx = starts[:, None] + np.arange(max_length)[None, :]
+    return token_ids[idx], token_ids[idx + 1]
+
+
+class PretrainDataset:
+    """Tokenize once, window lazily (reference DatasetPT, datautils/dataset.py:6)."""
+
+    def __init__(self, text: str, tokenizer, max_length: int, stride: int):
+        ids = tokenizer.encode(text, allowed_special={"<|endoftext|>"})
+        self.token_ids = np.asarray(ids, dtype=np.int32)
+        self.inputs, self.targets = make_windows(self.token_ids, max_length,
+                                                 stride)
+
+    def __len__(self) -> int:
+        return len(self.inputs)
+
+
+class PretrainLoader:
+    """Batched loader over one or more raw-text corpora.
+
+    Reference DataloaderPT (datautils/dataloader.py:9): 90/10 char split,
+    shuffled fixed-shape batches, per-process sharding for data parallelism.
+    """
+
+    def __init__(self, tokenizer, batch_size: int, max_length: int,
+                 stride: Optional[int] = None, train_ratio: float = 0.90,
+                 process_index: int = 0, process_count: int = 1,
+                 seed: int = 123):
+        self.tokenizer = tokenizer
+        self.batch_size = batch_size
+        self.max_length = max_length
+        self.stride = stride or max_length
+        self.train_ratio = train_ratio
+        self.process_index = process_index
+        self.process_count = process_count
+        self.seed = seed
+
+    def split_text(self, text: str) -> Tuple[str, str]:
+        """Char-level 90/10 split (reference dataloader.py:70)."""
+        split_idx = int(self.train_ratio * len(text))
+        return text[:split_idx], text[split_idx:]
+
+    def create_datasets(self, raw_text: str
+                        ) -> Tuple[PretrainDataset, PretrainDataset]:
+        train_text, val_text = self.split_text(raw_text)
+        train = PretrainDataset(train_text, self.tokenizer, self.max_length,
+                                self.stride)
+        val = PretrainDataset(val_text, self.tokenizer, self.max_length,
+                              self.stride)
+        return train, val
+
+    def batches(self, dataset: PretrainDataset, *, shuffle: bool = True,
+                epoch: int = 0) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        """Yield fixed-shape (inputs, targets) batches of this process's shard.
+
+        Shuffling is deterministic in (seed, epoch) on every process — the
+        ``sampler.set_epoch`` pattern (reference train.py:169-170) — and each
+        process takes a strided slice of the global batch order.
+        """
+        n = len(dataset)
+        order = np.arange(n)
+        if shuffle:
+            rng = np.random.default_rng(self.seed + epoch)
+            rng.shuffle(order)
+        # drop_last semantics: only full global batches (fixed XLA shapes)
+        global_bs = self.batch_size * self.process_count
+        n_batches = n // global_bs
+        for b in range(n_batches):
+            sl = order[b * global_bs:(b + 1) * global_bs]
+            mine = sl[self.process_index::self.process_count]
+            yield dataset.inputs[mine], dataset.targets[mine]
+
+    def num_batches(self, dataset: PretrainDataset) -> int:
+        return len(dataset) // (self.batch_size * self.process_count)
+
+    def get_total_steps_epoch(self, files: List[str],
+                              eos_text: str = "<|endoftext|>",
+                              read_fn=None) -> int:
+        """Count total optimizer steps per epoch across all corpus files.
+
+        Reference re-reads and re-tokenizes every file up front
+        (dataloader.py:87-103) to drive the cosine schedule; so do we,
+        including the trailing `` {eos_text} `` the trainer appends per file
+        (reference train.py:164-165).
+        """
+        from building_llm_from_scratch_tpu.utils.io import read_text_file
+
+        read_fn = read_fn or read_text_file
+        total = 0
+        for path in files:
+            text = read_fn(path) + f" {eos_text} "
+            train, _val = self.create_datasets(text)
+            total += self.num_batches(train)
+        return total

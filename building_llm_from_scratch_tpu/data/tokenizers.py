@@ -1,0 +1,194 @@
+"""Host-side tokenizers.
+
+Parity with the reference's three tokenizer paths (build_components.py:265-300):
+  - GPT-2 BPE via tiktoken                  (build_components.py:278)
+  - LLaMA-2 sentencepiece wrapper           (Models/Llama/Llama2.py:12-28)
+  - LLaMA-3 tiktoken BPE over Meta's
+    tokenizer.model + reserved specials     (Models/Llama/Llama3.py:14-51)
+
+Tokenization never touches the device; these stay plain Python. All wrappers
+expose the same small interface: ``encode(text, allowed_special=...)``,
+``decode(ids)``, ``.vocab_size``, ``.eos_id``.
+
+Because training environments may be offline, ``build_tokenizer`` degrades
+gracefully: if a tokenizer's assets are unavailable it raises a clear error,
+and a deterministic ``ByteTokenizer`` is provided for tests/smoke runs.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterable, List, Optional, Sequence
+
+
+class ByteTokenizer:
+    """Deterministic offline tokenizer: raw UTF-8 bytes + special tokens.
+
+    Used by tests and `--debug` smoke runs so the full pipeline works with
+    zero network egress. Ids 0-255 are bytes; specials get ids >= 256.
+    """
+
+    def __init__(self, specials: Sequence[str] = ("<|endoftext|>",)):
+        self.specials = {s: 256 + i for i, s in enumerate(specials)}
+        self._specials_by_id = {v: k for k, v in self.specials.items()}
+        self.vocab_size = 256 + len(self.specials)
+        self.eos_id = self.specials.get("<|endoftext|>", 256)
+
+    def encode(self, text: str, allowed_special: Optional[Iterable[str]] = None
+               ) -> List[int]:
+        allowed = set(allowed_special or self.specials)
+        out: List[int] = []
+        i = 0
+        while i < len(text):
+            matched = False
+            for s, sid in self.specials.items():
+                if s in allowed and text.startswith(s, i):
+                    out.append(sid)
+                    i += len(s)
+                    matched = True
+                    break
+            if not matched:
+                out.extend(text[i].encode("utf-8"))
+                i += 1
+        return out
+
+    def decode(self, ids: Sequence[int]) -> str:
+        parts: List[bytes] = []
+        for t in ids:
+            t = int(t)
+            if t in self._specials_by_id:
+                parts.append(self._specials_by_id[t].encode("utf-8"))
+            elif 0 <= t < 256:
+                parts.append(bytes([t]))
+            # ids outside the byte+special range (e.g. sampled from an
+            # untrained model with a larger vocab) decode to nothing
+        return b"".join(parts).decode("utf-8", errors="replace")
+
+
+class GPT2Tokenizer:
+    """GPT-2 BPE via tiktoken (reference build_components.py:278)."""
+
+    def __init__(self):
+        import tiktoken
+
+        self._enc = tiktoken.get_encoding("gpt2")
+        self.vocab_size = self._enc.n_vocab
+        self.eos_id = self._enc.eot_token            # 50256
+
+    def encode(self, text: str, allowed_special: Optional[Iterable[str]] = None
+               ) -> List[int]:
+        allowed = set(allowed_special or {"<|endoftext|>"})
+        return self._enc.encode(text, allowed_special=allowed)
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self._enc.decode(list(int(i) for i in ids))
+
+
+class Llama2Tokenizer:
+    """SentencePiece wrapper (reference Models/Llama/Llama2.py:12-28)."""
+
+    def __init__(self, model_path: str):
+        import sentencepiece as spm
+
+        if not os.path.exists(model_path):
+            raise FileNotFoundError(
+                f"LLaMA-2 sentencepiece model not found at {model_path}")
+        self._sp = spm.SentencePieceProcessor(model_file=model_path)
+        self.vocab_size = self._sp.vocab_size()
+        self.eos_id = self._sp.eos_id()              # 2
+
+    def encode(self, text: str, allowed_special: Optional[Iterable[str]] = None
+               ) -> List[int]:
+        return self._sp.encode(text)
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self._sp.decode(list(int(i) for i in ids))
+
+
+LLAMA3_SPLIT_PATTERN = (
+    r"(?i:'s|'t|'re|'ve|'m|'ll|'d)|[^\r\n\p{L}\p{N}]?\p{L}+|\p{N}{1,3}|"
+    r" ?[^\s\p{L}\p{N}]+[\r\n]*|\s*[\r\n]+|\s+(?!\S)|\s+"
+)
+
+
+class Llama3Tokenizer:
+    """tiktoken BPE over Meta's ``tokenizer.model`` + 256 reserved specials
+    (reference Models/Llama/Llama3.py:14-51)."""
+
+    def __init__(self, model_path: str):
+        import tiktoken
+        from tiktoken.load import load_tiktoken_bpe
+
+        if not os.path.exists(model_path):
+            raise FileNotFoundError(
+                f"LLaMA-3 tokenizer.model not found at {model_path}")
+        mergeable = load_tiktoken_bpe(model_path)
+        num_base = len(mergeable)               # 128000 for Meta's model
+        # Meta's exact special-token id layout: 256 specials fill ids
+        # num_base .. num_base+255, with the named ones interleaved among
+        # the reserved slots (so all ids stay < vocab_size = 128256).
+        ordered = [
+            "<|begin_of_text|>",                # 128000
+            "<|end_of_text|>",                  # 128001
+            "<|reserved_special_token_0|>",
+            "<|reserved_special_token_1|>",
+            "<|reserved_special_token_2|>",
+            "<|reserved_special_token_3|>",
+            "<|start_header_id|>",              # 128006
+            "<|end_header_id|>",                # 128007
+            "<|reserved_special_token_4|>",
+            "<|eot_id|>",                       # 128009
+        ] + [f"<|reserved_special_token_{i}|>" for i in range(5, 251)]
+        specials = {tok: num_base + i for i, tok in enumerate(ordered)}
+        self._enc = tiktoken.Encoding(
+            name=os.path.basename(model_path),
+            pat_str=LLAMA3_SPLIT_PATTERN,
+            mergeable_ranks=mergeable,
+            special_tokens=specials,
+        )
+        self.vocab_size = 128_256
+        self.eos_id = specials["<|end_of_text|>"]    # 128001
+
+    def encode(self, text: str, bos: bool = False, eos: bool = False,
+               allowed_special: Optional[Iterable[str]] = None) -> List[int]:
+        ids = self._enc.encode(
+            text, allowed_special=set(allowed_special or
+                                      self._enc.special_tokens_set))
+        if bos:
+            ids = [self._enc.encode_single_token("<|begin_of_text|>")] + ids
+        if eos:
+            ids = ids + [self.eos_id]
+        return ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self._enc.decode(list(int(i) for i in ids))
+
+
+def build_tokenizer(model: str, tokenizer_path: Optional[str] = None,
+                    fallback_byte: bool = False):
+    """Tokenizer factory (reference build_components.py:265-300).
+
+    The reference downloads tokenizer assets from HF hub behind rank barriers;
+    in offline environments pass ``tokenizer_path`` to local assets, or set
+    ``fallback_byte=True`` (debug/smoke runs) to get the ByteTokenizer.
+    """
+    try:
+        if model == "GPT2":
+            return GPT2Tokenizer()
+        if model == "llama2":
+            if tokenizer_path is None:
+                raise FileNotFoundError(
+                    "llama2 requires --tokenizer_path to a sentencepiece "
+                    "tokenizer.model")
+            return Llama2Tokenizer(tokenizer_path)
+        if model in ("llama3", "llama3_1", "llama3_2"):
+            if tokenizer_path is None:
+                raise FileNotFoundError(
+                    f"{model} requires --tokenizer_path to Meta's "
+                    "tokenizer.model")
+            return Llama3Tokenizer(tokenizer_path)
+    except Exception:
+        if fallback_byte:
+            return ByteTokenizer()
+        raise
+    raise ValueError(f"Unknown model '{model}'")

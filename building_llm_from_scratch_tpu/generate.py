@@ -1,0 +1,157 @@
+"""Autoregressive sampling.
+
+Capability parity with the reference's ``generate`` (generate.py:4-75):
+temperature sampling, top-k filtering, greedy argmax when temperature==0,
+and the all-rows-eos early stop (including its quirk of NOT appending the
+token that triggered the stop, generate.py:68-73).
+
+TPU-first design: the reference re-runs the FULL forward over the entire
+window for every new token (O(L·T²) per token, no KV cache —
+generate.py:36-45). Here decode is a jitted ``lax.while_loop`` over a
+static-shape KV cache: prefill once over the prompt, then one
+single-position forward per token. Compiles once per
+(batch, prompt_len, max_new_tokens) shape bucket.
+
+When prompt+new tokens exceed the model context, we fall back to the
+reference's sliding-window recompute semantics (slice to the last
+``context_size`` tokens, full forward per token) so behavior is identical.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from building_llm_from_scratch_tpu.configs import ModelConfig
+from building_llm_from_scratch_tpu.models.transformer import (
+    forward,
+    forward_with_cache,
+    init_cache,
+)
+
+
+def _sample_token(logits: jnp.ndarray, rng: jax.Array, temperature: float,
+                  top_k: Optional[int]) -> jnp.ndarray:
+    """Sample next-token ids from last-position logits (B, V).
+
+    Reference semantics (generate.py:48-65): top-k filter first, then
+    temperature-scaled multinomial, or plain argmax when temperature==0.
+    """
+    if top_k is not None:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if temperature > 0.0:
+        return jax.random.categorical(rng, logits / temperature, axis=-1)
+    return jnp.argmax(logits, axis=-1)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "max_new_tokens", "temperature", "top_k",
+                     "eos_id"))
+def _generate_cached(params, cfg: ModelConfig, prompt: jnp.ndarray,
+                     rng: jax.Array, max_new_tokens: int, temperature: float,
+                     top_k: Optional[int], eos_id: Optional[int]):
+    """KV-cache decode. Returns (tokens (B, Tp+max_new), n_generated)."""
+    B, Tp = prompt.shape
+    total = Tp + max_new_tokens
+    cache = init_cache(cfg, B, total)
+
+    logits, cache = forward_with_cache(params, cfg, prompt, cache)
+    buf = jnp.concatenate(
+        [prompt, jnp.zeros((B, max_new_tokens), prompt.dtype)], axis=1)
+
+    def cond(carry):
+        _buf, _cache, _last_logits, _rng, i, done = carry
+        return (i < max_new_tokens) & ~done
+
+    def body(carry):
+        buf, cache, last_logits, rng, i, done = carry
+        rng, sub = jax.random.split(rng)
+        nxt = _sample_token(last_logits, sub, temperature, top_k)  # (B,)
+        if eos_id is not None:
+            all_eos = jnp.all(nxt == eos_id)
+        else:
+            all_eos = jnp.asarray(False)
+        # reference quirk: the token that makes ALL rows hit eos is dropped
+        # and the loop stops (generate.py:68-73)
+        buf = jax.lax.cond(
+            all_eos, lambda b: b,
+            lambda b: jax.lax.dynamic_update_slice(b, nxt[:, None].astype(
+                b.dtype), (0, Tp + i)),
+            buf)
+        new_logits, cache = forward_with_cache(
+            params, cfg, nxt[:, None].astype(jnp.int32), cache)
+        return (buf, cache, new_logits[:, -1], rng, i + 1, all_eos)
+
+    carry = (buf, cache, logits[:, -1], rng, jnp.zeros((), jnp.int32),
+             jnp.asarray(False))
+    buf, _cache, _logits, _rng, i, done = jax.lax.while_loop(cond, body, carry)
+    n_generated = jnp.where(done, i - 1, i)
+    return buf, n_generated
+
+
+def generate(params, cfg: ModelConfig, token_ids, max_new_tokens: int,
+             context_size: Optional[int] = None, temperature: float = 0.0,
+             top_k: Optional[int] = None, eos_id: Optional[int] = None,
+             rng: Optional[jax.Array] = None) -> np.ndarray:
+    """Generate up to ``max_new_tokens`` after ``token_ids`` (B, Tp).
+
+    Returns a numpy (B, Tp + n_generated) array, mirroring the reference's
+    return of prompt+generated ids (generate.py:73-75).
+    """
+    context_size = context_size or cfg.context_length
+    token_ids = jnp.asarray(token_ids, jnp.int32)
+    if token_ids.ndim == 1:
+        token_ids = token_ids[None, :]
+    B, Tp = token_ids.shape
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+    if Tp + max_new_tokens <= context_size:
+        buf, n_gen = _generate_cached(params, cfg, token_ids, rng,
+                                      max_new_tokens, float(temperature),
+                                      top_k, eos_id)
+        n = int(n_gen)
+        return np.asarray(buf)[:, : Tp + n]
+
+    # Sliding-window fallback — the reference's per-token recompute semantics
+    # (generate.py:36-73), but with ONE compiled shape: windows shorter than
+    # ``context_size`` are right-padded (causality makes the padding inert)
+    # and the logits are read at the true last position. Without this, every
+    # growing prompt length would trigger a fresh XLA compile.
+    fwd = jax.jit(lambda p, t: forward(p, cfg, t))
+    ids = np.asarray(token_ids)
+    for _ in range(max_new_tokens):
+        cur = ids.shape[1]
+        if cur >= context_size:
+            window = ids[:, -context_size:]
+            last = context_size - 1
+        else:
+            window = np.concatenate(
+                [ids, np.zeros((B, context_size - cur), ids.dtype)], axis=1)
+            last = cur - 1
+        logits = fwd(params, jnp.asarray(window))[:, last]
+        rng, sub = jax.random.split(rng)
+        nxt = np.asarray(_sample_token(logits, sub, float(temperature), top_k))
+        if eos_id is not None and (nxt == eos_id).all():
+            break
+        ids = np.concatenate([ids, nxt[:, None].astype(ids.dtype)], axis=1)
+    return ids
+
+
+def text_to_token_ids(text: str, tokenizer) -> np.ndarray:
+    """Reference utils.py:71-77 (adds the batch dim)."""
+    ids = tokenizer.encode(text, allowed_special={"<|endoftext|>"})
+    return np.asarray(ids, np.int32)[None, :]
+
+
+def token_ids_to_text(token_ids, tokenizer) -> str:
+    """Reference utils.py:80-84 (strips the batch dim)."""
+    arr = np.asarray(token_ids)
+    if arr.ndim == 2:
+        arr = arr[0]
+    return tokenizer.decode([int(t) for t in arr])

@@ -1,0 +1,117 @@
+"""LoRA as a pytree partition.
+
+The reference implements LoRA by recursive nn.Module surgery — freezing all
+params, then replacing every nn.Linear with LinearWithLoRA
+(lora.py:29-65, build_components.py:117-135). Here adapters are a SEPARATE
+pytree mirroring the model's linear weights:
+
+  lora = {
+    "blocks": {"attn": {"wq": {"A": (L, in, r), "B": (L, r, out)}, ...},
+               "mlp":  {...}},
+    "head":   {"weight": {"A": (in, r), "B": (r, out)}},
+  }
+
+Training uses the partition directly: the optimizer sees ONLY the lora tree
+(so "freezing" is structural, not a requires_grad flag), and the forward
+pass runs on ``merge_lora(params, lora, scaling)`` — W' = W + (alpha/r)*A@B,
+which XLA fuses into the surrounding matmuls. Gradients flow to A/B through
+the merge; base weights are never touched.
+
+Matches the reference's placement: every Linear gets an adapter (all
+attention projections, all MLP projections, and the LM head — reference
+replace_linear_with_lora walks every nn.Linear, lora.py:49-65); embeddings
+do not (nn.Embedding is not nn.Linear).
+
+Init parity (reference lora.py:6-26): A ~ kaiming-uniform(a=sqrt(5)) over
+(in, r), B = 0, scaling = alpha / rank.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from building_llm_from_scratch_tpu.configs import ModelConfig
+
+Params = Dict[str, Any]
+
+# model-tree linear weights that receive adapters: path -> (stacked?, in_axis)
+_ADAPTED = {
+    ("blocks", "attn", "wq"),
+    ("blocks", "attn", "wk"),
+    ("blocks", "attn", "wv"),
+    ("blocks", "attn", "wo"),
+    ("blocks", "mlp", "up"),
+    ("blocks", "mlp", "down"),
+    ("blocks", "mlp", "gate"),
+    ("head", "weight"),
+}
+
+
+def _kaiming_uniform(key, shape, fan_in: int, dtype):
+    # torch kaiming_uniform_(a=sqrt(5)) => U(-1/sqrt(fan_in), 1/sqrt(fan_in))
+    bound = 1.0 / math.sqrt(fan_in)
+    return jax.random.uniform(key, shape, jnp.float32, -bound, bound
+                              ).astype(dtype)
+
+
+def init_lora_params(cfg: ModelConfig, params: Params, key: jax.Array,
+                     rank: int) -> Params:
+    """Build the adapter tree for every adapted linear in ``params``."""
+    dt = cfg.jax_dtype
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    out: Params = {}
+    keys = jax.random.split(key, len(flat))
+    for (path, leaf), k in zip(flat, keys):
+        names = tuple(p.key for p in path)
+        if names not in _ADAPTED:
+            continue
+        if leaf.ndim == 3:            # stacked per-layer weight (L, in, out)
+            L, fan_in, fan_out = leaf.shape
+            a = _kaiming_uniform(k, (L, fan_in, rank), fan_in, dt)
+            b = jnp.zeros((L, rank, fan_out), dt)
+        else:                         # (in, out), e.g. the head
+            fan_in, fan_out = leaf.shape
+            a = _kaiming_uniform(k, (fan_in, rank), fan_in, dt)
+            b = jnp.zeros((rank, fan_out), dt)
+        node = out
+        for name in names[:-1]:
+            node = node.setdefault(name, {})
+        node[names[-1]] = {"A": a, "B": b}
+    return out
+
+
+def merge_lora(params: Params, lora: Params, alpha: float, rank: int) -> Params:
+    """Return params with W' = W + (alpha/rank) * A @ B on adapted weights.
+
+    Pure and differentiable — grads w.r.t. ``lora`` flow through the merge
+    while ``params`` stays a constant of the step.
+    """
+    scaling = alpha / rank
+
+    def walk(p_node, l_node):
+        merged = {}
+        for name, child in p_node.items():
+            l_child = l_node.get(name) if isinstance(l_node, dict) else None
+            if isinstance(child, dict):
+                merged[name] = walk(child, l_child or {})
+            elif (isinstance(l_child, dict) and "A" in l_child):
+                a, b = l_child["A"], l_child["B"]
+                delta = jnp.einsum("...ir,...ro->...io", a, b)
+                merged[name] = child + scaling * delta.astype(child.dtype)
+            else:
+                merged[name] = child
+        return merged
+
+    return walk(params, lora)
+
+
+def count_lora_params(lora: Params) -> int:
+    """Trainable-parameter count (reference build_components.py:131-135)."""
+    import numpy as np
+
+    return int(sum(np.prod(l.shape)
+                   for l in jax.tree_util.tree_leaves(lora)))

@@ -1,0 +1,43 @@
+"""Training engine (reference: train.py, build_components.py optimizer tier)."""
+
+from building_llm_from_scratch_tpu.training.optim import (
+    build_optimizer,
+    warmup_cosine_schedule,
+)
+from building_llm_from_scratch_tpu.training.precision import (
+    POLICIES,
+    PrecisionPolicy,
+    cast_floating,
+    get_policy,
+)
+from building_llm_from_scratch_tpu.training.train_step import (
+    cross_entropy_loss,
+    init_train_state,
+    make_eval_step,
+    make_train_step,
+)
+from building_llm_from_scratch_tpu.training.checkpoint import (
+    export_params,
+    load_checkpoint,
+    load_exported_params,
+    save_checkpoint,
+)
+from building_llm_from_scratch_tpu.training.trainer import Trainer
+
+__all__ = [
+    "build_optimizer",
+    "warmup_cosine_schedule",
+    "POLICIES",
+    "PrecisionPolicy",
+    "cast_floating",
+    "get_policy",
+    "cross_entropy_loss",
+    "init_train_state",
+    "make_eval_step",
+    "make_train_step",
+    "export_params",
+    "load_checkpoint",
+    "load_exported_params",
+    "save_checkpoint",
+    "Trainer",
+]

@@ -1,0 +1,129 @@
+"""Checkpoint save/restore.
+
+The reference saves model weights only, with no optimizer state and NO
+resume path anywhere (train.py:231-257, SURVEY.md §5). This module provides
+the full design the reference lacks while keeping its export semantics:
+
+  - ``save_checkpoint`` / ``load_checkpoint``: the COMPLETE train state
+    (trainable + frozen params, optax state, step, rng) as one .npy file per
+    leaf + a JSON manifest — a resumable checkpoint. Only process 0 writes
+    (the reference's rank-0-save-with-barriers pattern, train.py:232-240);
+    restore can place leaves directly onto a target sharding so large models
+    never materialize unsharded on one chip.
+  - ``export_params`` / ``load_exported_params``: a single ``.npz`` of just
+    the model params — the analog of the reference's final
+    ``model_pg_final.pth`` full-state-dict export (main.py:171-172).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+Params = Dict[str, Any]
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def save_checkpoint(ckpt_dir: str, state: Params,
+                    extra_metadata: Optional[dict] = None) -> str:
+    """Write every leaf of ``state`` plus a manifest. Returns the dir."""
+    is_writer = jax.process_index() == 0
+    leaves = jax.tree_util.tree_flatten_with_path(state)[0]
+    if is_writer:
+        os.makedirs(ckpt_dir, exist_ok=True)
+    manifest = {"leaves": [], "metadata": extra_metadata or {}}
+    for i, (path, leaf) in enumerate(leaves):
+        name = f"leaf_{i:05d}"
+        arr = np.asarray(jax.device_get(leaf))
+        manifest["leaves"].append({
+            "index": i,
+            "path": _path_str(path),
+            "shape": list(arr.shape),
+            "dtype": str(arr.dtype),
+        })
+        if is_writer:
+            np.save(os.path.join(ckpt_dir, name + ".npy"), arr)
+    if is_writer:
+        with open(os.path.join(ckpt_dir, "manifest.json"), "w") as f:
+            json.dump(manifest, f, indent=1)
+    return ckpt_dir
+
+
+def load_checkpoint(ckpt_dir: str, template_state: Params,
+                    shardings: Optional[Params] = None) -> Params:
+    """Restore a checkpoint into the structure of ``template_state``.
+
+    ``template_state`` (e.g. a freshly initialized state) supplies the
+    pytree structure; leaf paths are cross-checked against the manifest.
+    If ``shardings`` (a matching pytree of jax.sharding.Sharding) is given,
+    each leaf is device_put directly to its target placement.
+    """
+    with open(os.path.join(ckpt_dir, "manifest.json")) as f:
+        manifest = json.load(f)
+    flat, treedef = jax.tree_util.tree_flatten_with_path(template_state)
+    if len(flat) != len(manifest["leaves"]):
+        raise ValueError(
+            f"Checkpoint has {len(manifest['leaves'])} leaves but template "
+            f"state has {len(flat)} — structure mismatch.")
+    shard_leaves = (jax.tree_util.tree_leaves(shardings)
+                    if shardings is not None else [None] * len(flat))
+    loaded = []
+    for (path, tmpl), meta, shard in zip(flat, manifest["leaves"],
+                                         shard_leaves):
+        if _path_str(path) != meta["path"]:
+            raise ValueError(
+                f"Leaf path mismatch: template {_path_str(path)} vs "
+                f"checkpoint {meta['path']}")
+        arr = np.load(os.path.join(ckpt_dir, f"leaf_{meta['index']:05d}.npy"))
+        arr = arr.astype(meta["dtype"])
+        if shard is not None:
+            loaded.append(jax.device_put(arr, shard))
+        else:
+            loaded.append(jax.device_put(arr))
+    return jax.tree_util.tree_unflatten(treedef, loaded)
+
+
+def checkpoint_metadata(ckpt_dir: str) -> dict:
+    with open(os.path.join(ckpt_dir, "manifest.json")) as f:
+        return json.load(f)["metadata"]
+
+
+def export_params(path: str, params: Params) -> str:
+    """Single-file params export (reference final .pth, main.py:171-172)."""
+    if jax.process_index() == 0:
+        flat = jax.tree_util.tree_flatten_with_path(params)[0]
+        arrays = {_path_str(p): np.asarray(jax.device_get(l))
+                  for p, l in flat}
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        np.savez(path, **arrays)
+    return path
+
+
+def load_exported_params(path: str, template_params: Params) -> Params:
+    """Load an ``export_params`` file into the template's structure."""
+    data = np.load(path)
+    flat, treedef = jax.tree_util.tree_flatten_with_path(template_params)
+    leaves = []
+    for p, tmpl in flat:
+        key = _path_str(p)
+        if key not in data:
+            raise KeyError(f"Export missing parameter {key}")
+        leaves.append(jax.device_put(data[key]))
+    return jax.tree_util.tree_unflatten(treedef, leaves)

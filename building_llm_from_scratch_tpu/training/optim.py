@@ -1,0 +1,63 @@
+"""Optimizer + LR schedule.
+
+Parity with the reference:
+  - AdamW, weight_decay=0.1, torch defaults    (build_components.py:243-258)
+  - hand-rolled linear-warmup + cosine-decay
+    LR computed per step                       (train.py:100-107)
+  - global-norm gradient clipping at 1.0       (train.py:114-120)
+
+The reference mutates optimizer.param_groups every step; here the schedule
+is a pure function of the step folded into the optax chain, so the whole
+update lives inside the jitted train step. ZeRO-1 (ZeroRedundancyOptimizer,
+build_components.py:250-256) is not a different optimizer in this design —
+it is a sharding spec over this optimizer's state (parallel/sharding.py).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import optax
+
+
+def warmup_cosine_schedule(peak_lr: float, initial_lr: float, min_lr: float,
+                           warmup_steps: int, total_steps: int):
+    """The reference's exact LR curve (train.py:100-107).
+
+    Step semantics match the reference's pre-increment counter: the first
+    optimizer step sees global_step=1.
+    """
+    warmup_steps = max(1, warmup_steps)
+    lr_increment = (peak_lr - initial_lr) / warmup_steps
+
+    def schedule(count):
+        step = count + 1                       # pre-incremented global_step
+        warm = initial_lr + step * lr_increment
+        denom = jnp.maximum(1, total_steps - warmup_steps)
+        progress = (step - warmup_steps) / denom
+        cosine = min_lr + (peak_lr - min_lr) * 0.5 * (
+            1.0 + jnp.cos(jnp.pi * progress))
+        return jnp.where(step < warmup_steps, warm, cosine)
+
+    return schedule
+
+
+def build_optimizer(peak_lr: float = 5e-4, initial_lr: float = 1e-5,
+                    min_lr: float = 1e-6, warmup_steps: int = 10,
+                    total_steps: int = 1000, weight_decay: float = 0.1,
+                    grad_clip_norm: float = 1.0,
+                    b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
+                    schedule=None) -> optax.GradientTransformation:
+    """clip(1.0) -> AdamW(wd=0.1) with the reference's warmup+cosine LR.
+
+    Pass ``schedule`` to reuse an already-built LR schedule (keeps the
+    logged LR and the applied LR the same object).
+    """
+    if schedule is None:
+        schedule = warmup_cosine_schedule(peak_lr, initial_lr, min_lr,
+                                          warmup_steps, total_steps)
+    return optax.chain(
+        optax.clip_by_global_norm(grad_clip_norm),
+        optax.scale_by_adam(b1=b1, b2=b2, eps=eps),
+        optax.add_decayed_weights(weight_decay),
+        optax.scale_by_learning_rate(schedule),
+    )

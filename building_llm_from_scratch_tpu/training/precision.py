@@ -1,0 +1,72 @@
+"""Mixed-precision policies.
+
+Parity with the reference's two precision mechanisms:
+  1. whole-model dtype (``--data_type fp32|fp16|bf16``): config dtype applied
+     to params and activations (build_components.py:67, utils.py:37-41);
+  2. FSDP ``MixedPrecision`` policies (``--mixed_precision``):
+     fp16 / bf16 / bf16_hybrid / fp32 with separate param, reduce (grad
+     comms) and buffer dtypes (datautils/mixed_precision.py:10-46).
+
+The TPU-native mapping: master params stay fp32, the train step casts a
+compute copy to ``compute_dtype`` for forward/backward, and gradients are
+accumulated/reduced in ``reduce_dtype`` (XLA's psum over ICI honors the
+operand dtype). ``bf16_hybrid`` (fp32 params / bf16 comms) becomes
+reduce_dtype=bf16 with compute_dtype=fp32.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from building_llm_from_scratch_tpu.configs import DTYPE_MAP
+
+
+@dataclasses.dataclass(frozen=True)
+class PrecisionPolicy:
+    name: str
+    compute_dtype: str = "fp32"   # dtype params are cast to for fwd/bwd
+    reduce_dtype: str = "fp32"    # dtype gradients are reduced in
+    master_dtype: str = "fp32"    # dtype of the optimizer's master params
+
+    @property
+    def jax_compute_dtype(self):
+        return DTYPE_MAP[self.compute_dtype]
+
+    @property
+    def jax_reduce_dtype(self):
+        return DTYPE_MAP[self.reduce_dtype]
+
+
+# Reference datautils/mixed_precision.py:10-46 name -> policy table.
+POLICIES = {
+    "fp16": PrecisionPolicy("fp16", compute_dtype="fp16", reduce_dtype="fp16"),
+    "bf16": PrecisionPolicy("bf16", compute_dtype="bf16", reduce_dtype="bf16"),
+    "bf16_hybrid": PrecisionPolicy("bf16_hybrid", compute_dtype="fp32",
+                                   reduce_dtype="bf16"),
+    "fp32": PrecisionPolicy("fp32"),
+}
+
+
+def get_policy(name: Optional[str]) -> Optional[PrecisionPolicy]:
+    """Look up a mixed-precision policy (None -> no policy, use model dtype)."""
+    if name is None:
+        return None
+    if name not in POLICIES:
+        raise ValueError(
+            f"Unknown mixed-precision policy '{name}'; "
+            f"options: {list(POLICIES)}")
+    return POLICIES[name]
+
+
+def cast_floating(tree, dtype):
+    """Cast floating-point leaves of a pytree to ``dtype`` (ints untouched)."""
+    def cast(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+
+    return jax.tree_util.tree_map(cast, tree)

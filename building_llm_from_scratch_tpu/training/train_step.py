@@ -1,0 +1,156 @@
+"""The jitted training step.
+
+The reference's per-batch work — LR schedule math, forward, CE loss,
+backward, global-norm clip, optimizer step, tokens-seen accounting
+(train.py:94-126) — compiles into ONE XLA program with donated state.
+Host Python only feeds batches and reads metrics.
+
+State layout (a plain pytree, so it shards/donates/checkpoints trivially):
+
+  state = {
+    "trainable": <params being optimized>,   # full model, or LoRA adapters
+    "frozen":    <non-trained params>,       # {} normally; base model w/ LoRA
+    "opt_state": <optax state>,
+    "step":      int32 scalar,
+    "rng":       PRNGKey (dropout stream; folded with step each batch),
+  }
+
+Loss masking: a single weighted cross entropy covers both workloads —
+pretraining passes weights=1 (plain mean, reference train.py:88-92) and
+instruction finetuning passes the collator's 0/1 weights, which reproduces
+torch F.cross_entropy's ignore_index=-100 mean exactly
+(see tests/test_data.py::test_collate_matches_reference_loss_set).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from building_llm_from_scratch_tpu.configs import ModelConfig
+from building_llm_from_scratch_tpu.models.lora import merge_lora
+from building_llm_from_scratch_tpu.models.transformer import forward
+from building_llm_from_scratch_tpu.training.precision import (
+    PrecisionPolicy,
+    cast_floating,
+)
+
+Params = Dict[str, Any]
+
+
+def cross_entropy_loss(logits: jnp.ndarray, targets: jnp.ndarray,
+                       weights: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Weighted token-mean cross entropy in fp32."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None].astype(jnp.int32),
+                             axis=-1)[..., 0]
+    if weights is None:
+        return -jnp.mean(ll)
+    w = weights.astype(jnp.float32)
+    return -(ll * w).sum() / jnp.maximum(w.sum(), 1.0)
+
+
+def make_full_params_fn(cfg: ModelConfig, *,
+                        lora_alpha: Optional[float] = None,
+                        lora_rank: Optional[int] = None,
+                        policy: Optional[PrecisionPolicy] = None
+                        ) -> Callable[[Params, Params], Params]:
+    """Build the trainable/frozen -> full-model-params combinator."""
+    use_lora = lora_rank is not None
+
+    def full_params(trainable: Params, frozen: Params) -> Params:
+        if use_lora:
+            params = merge_lora(frozen, trainable, lora_alpha, lora_rank)
+        else:
+            params = trainable
+        if policy is not None:
+            params = cast_floating(params, policy.jax_compute_dtype)
+        return params
+
+    return full_params
+
+
+def init_train_state(trainable: Params, optimizer: optax.GradientTransformation,
+                     rng: jax.Array, frozen: Optional[Params] = None) -> Params:
+    return {
+        "trainable": trainable,
+        "frozen": frozen if frozen is not None else {},
+        "opt_state": optimizer.init(trainable),
+        "step": jnp.zeros((), jnp.int32),
+        "rng": rng,
+    }
+
+
+def make_train_step(cfg: ModelConfig, optimizer: optax.GradientTransformation,
+                    *, lr_schedule: Optional[Callable] = None,
+                    lora_alpha: Optional[float] = None,
+                    lora_rank: Optional[int] = None,
+                    policy: Optional[PrecisionPolicy] = None,
+                    jit: bool = True) -> Callable:
+    """Build train_step(state, batch) -> (state, metrics).
+
+    batch: {"inputs": (B,T) i32, "targets": (B,T) i32, "weights": (B,T) f32}.
+    """
+    full_params = make_full_params_fn(cfg, lora_alpha=lora_alpha,
+                                      lora_rank=lora_rank, policy=policy)
+
+    def train_step(state: Params, batch: Dict[str, jnp.ndarray]
+                   ) -> Tuple[Params, Dict[str, jnp.ndarray]]:
+        step_rng = jax.random.fold_in(state["rng"], state["step"])
+
+        def loss_fn(trainable):
+            params = full_params(trainable, state["frozen"])
+            logits = forward(params, cfg, batch["inputs"], rng=step_rng,
+                             deterministic=(cfg.drop_rate <= 0.0))
+            return cross_entropy_loss(logits, batch["targets"],
+                                      batch.get("weights"))
+
+        loss, grads = jax.value_and_grad(loss_fn)(state["trainable"])
+        if policy is not None and policy.reduce_dtype != "fp32":
+            grads = cast_floating(grads, policy.jax_reduce_dtype)
+            grads = cast_floating(grads, jnp.float32)
+        updates, new_opt_state = optimizer.update(grads, state["opt_state"],
+                                                  state["trainable"])
+        new_trainable = optax.apply_updates(state["trainable"], updates)
+        new_state = {
+            "trainable": new_trainable,
+            "frozen": state["frozen"],
+            "opt_state": new_opt_state,
+            "step": state["step"] + 1,
+            "rng": state["rng"],
+        }
+        metrics = {
+            "loss": loss,
+            "grad_norm": optax.global_norm(grads),
+            "tokens": jnp.asarray(batch["inputs"].size, jnp.int32),
+        }
+        if lr_schedule is not None:
+            metrics["lr"] = lr_schedule(state["step"])
+        return new_state, metrics
+
+    if jit:
+        return jax.jit(train_step, donate_argnums=(0,))
+    return train_step
+
+
+def make_eval_step(cfg: ModelConfig, *,
+                   lora_alpha: Optional[float] = None,
+                   lora_rank: Optional[int] = None,
+                   policy: Optional[PrecisionPolicy] = None,
+                   jit: bool = True) -> Callable:
+    """Build eval_step(state, batch) -> loss (deterministic, no grads)."""
+    full_params = make_full_params_fn(cfg, lora_alpha=lora_alpha,
+                                      lora_rank=lora_rank, policy=policy)
+
+    def eval_step(state: Params, batch: Dict[str, jnp.ndarray]) -> jnp.ndarray:
+        params = full_params(state["trainable"], state["frozen"])
+        logits = forward(params, cfg, batch["inputs"])
+        return cross_entropy_loss(logits, batch["targets"],
+                                  batch.get("weights"))
+
+    if jit:
+        return jax.jit(eval_step)
+    return eval_step

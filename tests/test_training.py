@@ -1,0 +1,354 @@
+"""Training-engine tests: schedule, loss, step, LoRA, checkpoints, trainer."""
+
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from building_llm_from_scratch_tpu.configs import get_config
+from building_llm_from_scratch_tpu.data import ByteTokenizer, PretrainLoader
+from building_llm_from_scratch_tpu.models import init_params
+from building_llm_from_scratch_tpu.models.lora import (
+    count_lora_params,
+    init_lora_params,
+    merge_lora,
+)
+from building_llm_from_scratch_tpu.training import (
+    Trainer,
+    build_optimizer,
+    cross_entropy_loss,
+    get_policy,
+    init_train_state,
+    load_checkpoint,
+    load_exported_params,
+    make_eval_step,
+    make_train_step,
+    save_checkpoint,
+    export_params,
+    warmup_cosine_schedule,
+)
+
+
+def tiny_cfg(**kw):
+    return get_config("GPT2", "124M", debug=True, **kw)
+
+
+def tiny_llama(**kw):
+    return get_config("llama3_2", "1B", debug=True, **kw)
+
+
+def make_batch(cfg, bs=2, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.integers(0, cfg.vocab_size, (bs, cfg.context_length)).astype(np.int32)
+    y = np.roll(x, -1, axis=1).astype(np.int32)
+    w = np.ones_like(x, np.float32)
+    return {"inputs": x, "targets": y, "weights": w}
+
+
+# ---------------------------------------------------------------------------
+# LR schedule
+# ---------------------------------------------------------------------------
+
+def test_schedule_matches_reference_formula():
+    """Transcribe the reference LR math (train.py:100-107) and compare."""
+    peak, init, mn, warm, total = 5e-4, 1e-5, 1e-6, 10, 100
+    sched = warmup_cosine_schedule(peak, init, mn, warm, total)
+    incr = (peak - init) / warm
+    for count in range(total):
+        step = count + 1                     # reference pre-increments
+        if step < warm:
+            ref = init + step * incr
+        else:
+            progress = (step - warm) / (total - warm)
+            ref = mn + (peak - mn) * 0.5 * (1 + math.cos(math.pi * progress))
+        assert abs(float(sched(count)) - ref) < 1e-9, step
+
+
+def test_schedule_endpoints():
+    sched = warmup_cosine_schedule(5e-4, 1e-5, 1e-6, 10, 1000)
+    assert float(sched(0)) < 1e-4            # starts near initial_lr
+    assert abs(float(sched(9)) - 5e-4) < 1e-4   # ~peak after warmup
+    assert abs(float(sched(999)) - 1e-6) < 1e-8  # ends at min_lr
+
+
+# ---------------------------------------------------------------------------
+# Loss
+# ---------------------------------------------------------------------------
+
+def test_cross_entropy_matches_torch():
+    torch = pytest.importorskip("torch")
+    B, T, V = 2, 8, 32
+    logits = np.random.randn(B, T, V).astype(np.float32)
+    targets = np.random.randint(0, V, (B, T))
+    ours = float(cross_entropy_loss(jnp.asarray(logits), jnp.asarray(targets)))
+    ref = float(torch.nn.functional.cross_entropy(
+        torch.from_numpy(logits).flatten(0, 1),
+        torch.from_numpy(targets).flatten()))
+    assert abs(ours - ref) < 1e-5
+
+
+def test_cross_entropy_weighted_ignores_masked():
+    B, T, V = 1, 4, 8
+    logits = np.random.randn(B, T, V).astype(np.float32)
+    targets = np.array([[1, 2, 3, 4]])
+    w_full = np.ones((B, T), np.float32)
+    w_half = np.array([[1, 1, 0, 0]], np.float32)
+    l_half = float(cross_entropy_loss(jnp.asarray(logits),
+                                      jnp.asarray(targets),
+                                      jnp.asarray(w_half)))
+    ref = float(cross_entropy_loss(jnp.asarray(logits[:, :2]),
+                                   jnp.asarray(targets[:, :2]),
+                                   jnp.asarray(w_full[:, :2])))
+    assert abs(l_half - ref) < 1e-6
+
+
+# ---------------------------------------------------------------------------
+# Train step
+# ---------------------------------------------------------------------------
+
+def test_train_step_reduces_loss(rng_key):
+    cfg = tiny_cfg()
+    params = init_params(cfg, rng_key)
+    opt = build_optimizer(peak_lr=1e-2, warmup_steps=2, total_steps=60)
+    state = init_train_state(params, opt, jax.random.PRNGKey(0))
+    step = make_train_step(cfg, opt,
+                           lr_schedule=warmup_cosine_schedule(
+                               1e-2, 1e-5, 1e-6, 2, 60))
+    batch = make_batch(cfg)                  # memorize one batch
+    losses = []
+    for _ in range(40):
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0] - 1.0, losses[:3] + losses[-3:]
+    assert int(state["step"]) == 40
+    assert "lr" in metrics and metrics["grad_norm"] >= 0
+
+
+def test_eval_step_deterministic(rng_key):
+    cfg = tiny_cfg()
+    params = init_params(cfg, rng_key)
+    opt = build_optimizer(total_steps=10)
+    state = init_train_state(params, opt, jax.random.PRNGKey(0))
+    ev = make_eval_step(cfg)
+    batch = make_batch(cfg)
+    assert float(ev(state, batch)) == float(ev(state, batch))
+
+
+def test_mixed_precision_policy_step(rng_key):
+    cfg = tiny_cfg()
+    params = init_params(cfg, rng_key)      # fp32 master
+    opt = build_optimizer(total_steps=10)
+    state = init_train_state(params, opt, jax.random.PRNGKey(0))
+    step = make_train_step(cfg, opt, policy=get_policy("bf16"))
+    state, metrics = step(state, make_batch(cfg))
+    # master params stay fp32 even though compute ran in bf16
+    assert state["trainable"]["tok_emb"]["weight"].dtype == jnp.float32
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_policy_registry_matches_reference_names():
+    # reference datautils/mixed_precision.py defines exactly these four
+    for name in ("fp16", "bf16", "bf16_hybrid", "fp32"):
+        assert get_policy(name) is not None
+    with pytest.raises(ValueError):
+        get_policy("int8")
+    assert get_policy(None) is None
+    assert get_policy("bf16_hybrid").reduce_dtype == "bf16"
+    assert get_policy("bf16_hybrid").compute_dtype == "fp32"
+
+
+# ---------------------------------------------------------------------------
+# LoRA
+# ---------------------------------------------------------------------------
+
+def test_lora_zero_init_is_identity(rng_key):
+    from building_llm_from_scratch_tpu.models import forward
+
+    cfg = tiny_llama()
+    params = init_params(cfg, rng_key)
+    lora = init_lora_params(cfg, params, jax.random.PRNGKey(1), rank=4)
+    merged = merge_lora(params, lora, alpha=8, rank=4)
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    np.testing.assert_allclose(
+        np.asarray(forward(params, cfg, tokens)),
+        np.asarray(forward(merged, cfg, tokens)), rtol=1e-6, atol=1e-6)
+
+
+def test_lora_adapts_all_linears(rng_key):
+    cfg = tiny_llama()
+    params = init_params(cfg, rng_key)
+    lora = init_lora_params(cfg, params, jax.random.PRNGKey(1), rank=4)
+    assert set(lora["blocks"]["attn"]) == {"wq", "wk", "wv", "wo"}
+    assert set(lora["blocks"]["mlp"]) == {"up", "down", "gate"}
+    assert "weight" in lora["head"]
+    # stacked adapters carry the layer axis
+    assert lora["blocks"]["attn"]["wq"]["A"].shape[0] == cfg.n_layers
+    assert count_lora_params(lora) > 0
+
+
+def test_lora_train_step_only_updates_adapters(rng_key):
+    cfg = tiny_llama()
+    params = init_params(cfg, rng_key)
+    lora = init_lora_params(cfg, params, jax.random.PRNGKey(1), rank=4)
+    opt = build_optimizer(peak_lr=1e-2, total_steps=20)
+    state = init_train_state(lora, opt, jax.random.PRNGKey(0), frozen=params)
+    step = make_train_step(cfg, opt, lora_alpha=8, lora_rank=4)
+    base_before = jax.tree_util.tree_map(np.asarray, state["frozen"])
+    batch = make_batch(cfg)
+    losses = []
+    for _ in range(15):
+        state, m = step(state, batch)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0]            # adapters actually learn
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(a, np.asarray(b)),
+        base_before, state["frozen"])        # base frozen structurally
+    # B matrices moved away from zero
+    assert float(jnp.abs(state["trainable"]["blocks"]["attn"]["wq"]["B"]).max()) > 0
+
+
+# ---------------------------------------------------------------------------
+# Checkpointing
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_roundtrip_and_resume(rng_key, tmp_path):
+    cfg = tiny_cfg()
+    params = init_params(cfg, rng_key)
+    opt = build_optimizer(peak_lr=1e-3, total_steps=20)
+    state = init_train_state(params, opt, jax.random.PRNGKey(0))
+    step = make_train_step(cfg, opt)
+    batch = make_batch(cfg)
+    for _ in range(3):
+        state, _ = step(state, batch)
+    ckpt = str(tmp_path / "ckpt")
+    save_checkpoint(ckpt, state, extra_metadata={"global_step": 3})
+
+    template = init_train_state(init_params(cfg, jax.random.PRNGKey(9)), opt,
+                                jax.random.PRNGKey(0))
+    restored = load_checkpoint(ckpt, template)
+    assert int(restored["step"]) == 3
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        state, restored)
+    # resuming: one more step from restored equals one more step from live
+    s1, m1 = step(state, batch)
+    s2, m2 = step(restored, batch)
+    assert abs(float(m1["loss"]) - float(m2["loss"])) < 1e-6
+
+
+def test_export_params_roundtrip(rng_key, tmp_path):
+    cfg = tiny_cfg()
+    params = init_params(cfg, rng_key)
+    path = str(tmp_path / "model_pg_final.npz")
+    export_params(path, params)
+    restored = load_exported_params(path, init_params(cfg,
+                                                      jax.random.PRNGKey(5)))
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        params, restored)
+
+
+# ---------------------------------------------------------------------------
+# Generation
+# ---------------------------------------------------------------------------
+
+def test_generate_greedy_deterministic(rng_key):
+    from building_llm_from_scratch_tpu.generate import generate
+
+    cfg = tiny_llama()
+    params = init_params(cfg, rng_key)
+    prompt = np.array([[1, 2, 3]], np.int32)
+    out1 = generate(params, cfg, prompt, max_new_tokens=5)
+    out2 = generate(params, cfg, prompt, max_new_tokens=5)
+    np.testing.assert_array_equal(out1, out2)
+    assert out1.shape[1] <= 8
+    np.testing.assert_array_equal(out1[:, :3], prompt)
+
+
+def test_generate_cached_matches_sliding_window(rng_key):
+    """The jitted KV-cache path must produce the same greedy tokens as the
+    reference-style full-recompute path."""
+    from building_llm_from_scratch_tpu.generate import generate
+
+    cfg = tiny_llama()
+    params = init_params(cfg, rng_key)
+    prompt = np.array([[5, 6, 7, 8]], np.int32)
+    cached = generate(params, cfg, prompt, max_new_tokens=6,
+                      context_size=cfg.context_length)
+    # force the sliding-window fallback with a small context_size
+    slide = generate(params, cfg, prompt, max_new_tokens=6,
+                     context_size=10)
+    # both grow from the same prompt; with ctx>=total they must agree
+    np.testing.assert_array_equal(cached, slide)
+
+
+def test_generate_respects_top_k_and_temperature(rng_key):
+    from building_llm_from_scratch_tpu.generate import generate
+
+    cfg = tiny_llama()
+    params = init_params(cfg, rng_key)
+    prompt = np.array([[1, 2]], np.int32)
+    a = generate(params, cfg, prompt, max_new_tokens=5, temperature=1.0,
+                 top_k=5, rng=jax.random.PRNGKey(1))
+    b = generate(params, cfg, prompt, max_new_tokens=5, temperature=1.0,
+                 top_k=5, rng=jax.random.PRNGKey(2))
+    assert a.shape == b.shape
+    # different rngs usually sample different continuations
+    assert not np.array_equal(a, b) or a.shape[1] == 2
+
+
+# ---------------------------------------------------------------------------
+# Trainer end-to-end (tiny, CPU)
+# ---------------------------------------------------------------------------
+
+def test_trainer_pretrain_end_to_end(rng_key, tmp_path):
+    cfg = tiny_cfg()
+    params = init_params(cfg, rng_key)
+    tok = ByteTokenizer()
+    datafile = tmp_path / "corpus.txt"
+    datafile.write_text("the quick brown fox jumps over the lazy dog. " * 200)
+    loader = PretrainLoader(tok, batch_size=2, max_length=cfg.context_length)
+    trainer = Trainer(cfg, params, tok, loader,
+                      output_dir=str(tmp_path / "out"),
+                      eval_freq=5, print_sample_iter=1000,
+                      save_ckpt_freq=10_000, warmup_steps=2)
+    trainer.train_model([str(datafile)], n_epochs=1, start_context="the ")
+    assert trainer.global_step > 0
+    assert trainer.tokens_seen > 0
+    assert len(trainer.train_losses) >= 1
+    assert np.isfinite(trainer.train_losses[-1])
+    out = trainer.export_final()
+    assert os.path.exists(out)
+
+
+def test_trainer_finetune_end_to_end(rng_key, tmp_path):
+    import json
+
+    from building_llm_from_scratch_tpu.data import InstructLoader
+
+    # context long enough that byte-level prompts leave supervised response
+    # tokens after the instruction mask
+    cfg = tiny_llama().replace(context_length=256)
+    params = init_params(cfg, rng_key)
+    lora = init_lora_params(cfg, params, jax.random.PRNGKey(1), rank=4)
+    tok = ByteTokenizer()
+    records = [{"instruction": f"repeat {i}", "input": "",
+                "output": f"{i} " * 3} for i in range(40)]
+    datafile = tmp_path / "alpaca_data.json"
+    datafile.write_text(json.dumps(records))
+    loader = InstructLoader(tok, batch_size=2, max_length=cfg.context_length,
+                            pad_token_id=tok.eos_id)
+    trainer = Trainer(cfg, params, tok, loader,
+                      output_dir=str(tmp_path / "out"),
+                      eval_freq=5, print_sample_iter=1000,
+                      save_ckpt_freq=10_000, warmup_steps=2,
+                      lora_params=lora, lora_alpha=8, lora_rank=4)
+    trainer.finetune_model([str(datafile)], n_epochs=1)
+    assert trainer.global_step > 0
+    assert np.isfinite(trainer.train_losses[-1])
+    assert trainer.train_losses[-1] > 0  # mask left supervised tokens
