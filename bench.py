@@ -1,0 +1,81 @@
+"""Benchmark: tokens/sec/chip for GPT2-124M causal-LM pretraining.
+
+BASELINE.json config #1 ("GPT2-124M single-device pretrain on Gutenberg,
+fp32, no LoRA/ckpt"). The reference publishes NO numbers (BASELINE.md), so
+``vs_baseline`` is measured against the first recorded figure for this repo
+(BASELINE.md "measured" table); 1.0 means parity with that record.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import numpy as np
+
+# First recorded tokens/sec/chip for this config on TPU v5e-1 (BASELINE.md).
+RECORDED_BASELINE = None  # set after the first measured run
+
+
+def bench_gpt2_pretrain(batch_size: int = 4, warmup: int = 3,
+                        iters: int = 20) -> float:
+    # batch 4 == the reference's default (args.py:53); fp32 + no remat at
+    # batch 8 exceeds one v5e chip's 16GB HBM
+    from building_llm_from_scratch_tpu.configs import get_config
+    from building_llm_from_scratch_tpu.models import init_params
+    from building_llm_from_scratch_tpu.training import (
+        build_optimizer,
+        init_train_state,
+        make_train_step,
+    )
+
+    cfg = get_config("GPT2", "124M", dtype="fp32")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    opt = build_optimizer(total_steps=warmup + iters + 1)
+    state = init_train_state(params, opt, jax.random.PRNGKey(0))
+    step = make_train_step(cfg, opt)
+
+    rng = np.random.default_rng(0)
+    T = cfg.context_length
+    batch = {
+        "inputs": rng.integers(0, cfg.vocab_size, (batch_size, T)).astype(
+            np.int32),
+        "targets": rng.integers(0, cfg.vocab_size, (batch_size, T)).astype(
+            np.int32),
+        "weights": np.ones((batch_size, T), np.float32),
+    }
+
+    # NOTE: on the axon remote backend jax.block_until_ready() returns at
+    # dispatch time — only a literal device_get round-trips to the chip, so
+    # all timing syncs use float()/device_get.
+    for _ in range(max(1, warmup)):
+        state, metrics = step(state, batch)
+    float(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, metrics = step(state, batch)
+    float(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = batch_size * T
+    n_chips = jax.device_count()
+    return tokens_per_step * iters / dt / n_chips
+
+
+def main():
+    tps = bench_gpt2_pretrain()
+    vs = tps / RECORDED_BASELINE if RECORDED_BASELINE else 1.0
+    print(json.dumps({
+        "metric": "tokens/sec/chip GPT2-124M pretrain fp32 bs4 ctx1024",
+        "value": round(tps, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,43 @@
+"""Parallelism tier: mesh, sharding rule tables, collectives.
+
+Replaces the reference's L1 distributed runtime (NCCL process groups,
+DDP/FSDP wrappers, ZeroRedundancyOptimizer) with one mesh + GSPMD specs.
+"""
+
+from building_llm_from_scratch_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    SEQ_AXIS,
+    initialize_distributed,
+    make_mesh,
+)
+from building_llm_from_scratch_tpu.parallel.sharding import (
+    SHARD_MODES,
+    MeshPlan,
+    build_mesh_plan,
+)
+from building_llm_from_scratch_tpu.parallel.collectives import (
+    all_gather,
+    gather_full,
+    is_coordinator,
+    ppermute_next,
+    psum,
+    sync_global_devices,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "SEQ_AXIS",
+    "initialize_distributed",
+    "make_mesh",
+    "SHARD_MODES",
+    "MeshPlan",
+    "build_mesh_plan",
+    "all_gather",
+    "gather_full",
+    "is_coordinator",
+    "ppermute_next",
+    "psum",
+    "sync_global_devices",
+]

@@ -1,0 +1,80 @@
+"""Collective / multi-host helpers.
+
+Maps the reference's explicit torch.distributed calls to their TPU-native
+equivalents (SURVEY.md §2.2 "Communication backend"):
+
+  torch.distributed.barrier()        -> sync_global_devices()
+  rank == 0 gating                   -> is_coordinator()
+  dist.all_reduce (DDP grads)        -> implicit: GSPMD psum under jit
+  FSDP all-gather / reduce-scatter   -> implicit: GSPMD from sharding specs
+  FSDP FULL_STATE_DICT gather        -> gather_full(tree)
+
+Explicit collectives (psum/all_gather/ppermute) are provided for
+``shard_map`` kernels (ring attention) that hand-schedule communication.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+def is_coordinator() -> bool:
+    """Process-0 check (the reference's ``rank == 0`` pattern)."""
+    return jax.process_index() == 0
+
+
+def sync_global_devices(name: str = "barrier") -> None:
+    """Cross-host barrier (reference dist.barrier, main.py:178 etc.)."""
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)
+
+
+def gather_full(tree: Any) -> Any:
+    """Gather a (possibly sharded) pytree to full host values — the analog
+    of FSDP's FULL_STATE_DICT rank-0 gather (reference train.py:244-249).
+
+    Single-process: device_get reassembles local shards. Multi-process:
+    arrays span non-addressable devices, so each leaf goes through a
+    process_allgather collective first (every host ends with the full
+    value, matching the reference's CPU-offload gather)."""
+    import numpy as np
+
+    multi = jax.process_count() > 1
+
+    def gather(x):
+        if not isinstance(x, jax.Array):
+            return x
+        if multi and not x.is_fully_addressable:
+            from jax.experimental import multihost_utils
+
+            return np.asarray(
+                multihost_utils.process_allgather(x, tiled=True))
+        return np.asarray(jax.device_get(x))
+
+    return jax.tree_util.tree_map(gather, tree)
+
+
+# shard_map building blocks -------------------------------------------------
+
+def psum(x, axis_name: str):
+    return jax.lax.psum(x, axis_name)
+
+
+def all_gather(x, axis_name: str, axis: int = 0, tiled: bool = True):
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def ppermute_next(x, axis_name: str, axis_size: int):
+    """Rotate shards one step around the ring (ring attention's primitive)."""
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+def axis_index(axis_name: str):
+    return jax.lax.axis_index(axis_name)

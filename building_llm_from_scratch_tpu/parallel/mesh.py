@@ -1,0 +1,75 @@
+"""Device mesh construction and multi-host runtime init.
+
+Replaces the reference's process/distributed runtime (L1):
+  - ``mp.spawn`` one-process-per-GPU + NCCL rendezvous on
+    localhost:12355 (reference main.py:22-34,185-193) becomes
+    ``jax.distributed.initialize()`` — TPU pods auto-discover peers, no
+    MASTER_ADDR analog;
+  - the process group IS the mesh: one ``jax.sharding.Mesh`` whose axes
+    span ICI (intra-slice) and DCN (inter-slice).
+
+Mesh axes:
+  data   — batch/data parallelism AND fully-sharded params (FSDP mode)
+  seq    — sequence/context parallelism (ring attention)
+  model  — tensor parallelism
+
+The reference's three strategies (DDP / FSDP / ZeRO-1) plus the TPU-first
+extensions (TP, SP) are all sharding-rule tables over this one mesh
+(parallel/sharding.py) — not separate wrapper code paths.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+SEQ_AXIS = "seq"
+MODEL_AXIS = "model"
+
+
+def initialize_distributed(coordinator_address: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None) -> None:
+    """Initialize the multi-host JAX runtime when running on >1 process.
+
+    On TPU pods ``jax.distributed.initialize()`` discovers everything from
+    the TPU metadata; explicit args cover GPU/CPU clusters. Safe no-op for
+    single-process runs.
+    """
+    if num_processes is None:
+        env_n = int(os.environ.get("JAX_NUM_PROCESSES", "1"))
+        if env_n > 1:
+            num_processes = env_n
+    if num_processes is None and coordinator_address is None:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def make_mesh(data: int = -1, seq: int = 1, model: int = 1,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a (data, seq, model) mesh over all devices.
+
+    ``data=-1`` absorbs the remaining devices after seq/model are fixed —
+    the common case: ``make_mesh()`` is pure data parallel over every chip.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if data == -1:
+        if n % (seq * model) != 0:
+            raise ValueError(
+                f"{n} devices not divisible by seq*model={seq * model}")
+        data = n // (seq * model)
+    if data * seq * model != n:
+        raise ValueError(
+            f"mesh {data}x{seq}x{model} != {n} available devices")
+    arr = np.asarray(devices).reshape(data, seq, model)
+    return Mesh(arr, (DATA_AXIS, SEQ_AXIS, MODEL_AXIS))

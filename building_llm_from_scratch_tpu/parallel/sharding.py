@@ -1,0 +1,240 @@
+"""The sharding rule table: parallelism strategies as data.
+
+The reference implements its strategies as three separate wrapper code
+paths — DDP wrap (build_components.py:176), FSDP wrap with a module
+wrap-policy (build_components.py:154-174), and ZeroRedundancyOptimizer
+(build_components.py:250-256). Here each strategy is a table of
+``PartitionSpec`` rules applied to the SAME pytrees; XLA's GSPMD partitioner
+inserts the collectives the torch wrappers hand-code:
+
+  mode     params            optimizer state      batch       collectives XLA inserts
+  ----     ------            ---------------      -----       ------------------------
+  dp       replicated        replicated           data-axis   grad psum (≡ DDP all-reduce)
+  fsdp     sharded on data   sharded on data      data-axis   param all-gather fwd/bwd +
+                                                              grad reduce-scatter (≡ FSDP)
+  zero1    replicated        sharded on data      data-axis   grad psum + state scatter/
+                                                              gather (≡ ZeRO-1)
+  tp       attn/mlp heads    follows params       data-axis   activation psums
+           on model axis                                      (Megatron-style)
+
+FSDP sharding rule: shard the LARGEST non-layer axis divisible by the mesh
+size — the spec-level equivalent of the reference's
+``ModuleWrapPolicy([nn.Embedding, TransformerBlock])`` granularity
+(build_components.py:172), except every tensor shards (no wrap-policy
+special cases). Stacked layer params (L, in, out) never shard the scan axis.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from building_llm_from_scratch_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    SEQ_AXIS,
+    make_mesh,
+)
+
+Params = Dict[str, Any]
+
+SHARD_MODES = ("dp", "fsdp", "zero1", "tp", "tp_fsdp")
+
+# Megatron-style tensor-parallel rules: path suffix -> axis index to shard
+# on the model axis, expressed on the UNSTACKED (per-layer) shape; block
+# params carry a leading scan axis at runtime, handled in param_spec.
+# Column-parallel (shard output) for QKV/up/gate, row-parallel (shard
+# input) for the output projections; vocab-parallel embedding + head.
+_TP_RULES: Dict[Tuple[str, ...], int] = {
+    ("blocks", "attn", "wq"): 1,      # (D, H*hd) -> shard heads
+    ("blocks", "attn", "wk"): 1,
+    ("blocks", "attn", "wv"): 1,
+    ("blocks", "attn", "bq"): 0,
+    ("blocks", "attn", "bk"): 0,
+    ("blocks", "attn", "bv"): 0,
+    ("blocks", "attn", "wo"): 0,      # (H*hd, D) -> shard input
+    ("blocks", "mlp", "up"): 1,
+    ("blocks", "mlp", "gate"): 1,
+    ("blocks", "mlp", "b_up"): 0,
+    ("blocks", "mlp", "down"): 0,
+    ("tok_emb", "weight"): 0,         # (V, D) vocab-parallel
+    ("head", "weight"): 1,            # (D, V) vocab-parallel
+}
+
+
+def _path_names(path) -> Tuple[str, ...]:
+    names = []
+    for p in path:
+        if hasattr(p, "key"):
+            names.append(str(p.key))
+        elif hasattr(p, "name"):
+            names.append(str(p.name))
+        # skip positional (namedtuple/sequence) entries — optimizer state
+        # wraps the param tree in GradientTransformation state tuples
+    return tuple(names)
+
+
+def _fsdp_axis(shape: Tuple[int, ...], n_shards: int,
+               skip_leading_layer_axis: bool,
+               exclude: Optional[int] = None) -> Optional[int]:
+    """Pick the largest axis divisible by ``n_shards`` (None -> replicate),
+    optionally excluding an axis already claimed by tensor parallelism."""
+    if not shape:
+        return None
+    start = 1 if (skip_leading_layer_axis and len(shape) >= 2) else 0
+    best, best_size = None, 0
+    for i in range(start, len(shape)):
+        if i == exclude:
+            continue
+        if shape[i] % n_shards == 0 and shape[i] >= n_shards \
+                and shape[i] > best_size:
+            best, best_size = i, shape[i]
+    return best
+
+
+def _spec_with_axis(ndim: int, axis: Optional[int], mesh_axis: str) -> P:
+    if axis is None:
+        return P()
+    spec = [None] * ndim
+    spec[axis] = mesh_axis
+    return P(*spec)
+
+
+@dataclasses.dataclass
+class MeshPlan:
+    """A mesh + shard mode; knows how to place params, optimizer state and
+    batches. This object REPLACES the reference's multigpu_setup
+    (build_components.py:142-182) and optimizer sharding wrapper."""
+
+    mesh: Mesh
+    shard_mode: str = "dp"
+    # params with fewer elements than this stay replicated in fsdp modes
+    # (tiny tensors cost more to gather than they save — same motivation as
+    # FSDP's min_num_params wrap policies)
+    fsdp_min_size: int = 1024
+
+    def __post_init__(self):
+        if self.shard_mode not in SHARD_MODES:
+            raise ValueError(
+                f"shard_mode '{self.shard_mode}' not in {SHARD_MODES}")
+
+    # -- sizes ---------------------------------------------------------
+
+    @property
+    def n_data(self) -> int:
+        return self.mesh.shape[DATA_AXIS]
+
+    @property
+    def n_model(self) -> int:
+        return self.mesh.shape[MODEL_AXIS]
+
+    # -- spec rules ----------------------------------------------------
+
+    def _is_stacked(self, names: Tuple[str, ...]) -> bool:
+        return "blocks" in names
+
+    def param_spec(self, names: Tuple[str, ...], shape: Tuple[int, ...]) -> P:
+        """PartitionSpec for a model parameter leaf."""
+        tp_axis = None
+        if self.shard_mode in ("tp", "tp_fsdp") and self.n_model > 1:
+            for suffix, ax in _TP_RULES.items():
+                if names[-len(suffix):] == suffix:
+                    # block tensors carry a leading scan axis at runtime
+                    tp_axis = ax + 1 if self._is_stacked(names) else ax
+                    if tp_axis >= len(shape) \
+                            or shape[tp_axis] % self.n_model != 0:
+                        tp_axis = None
+                    break
+        fsdp_axis = None
+        if self.shard_mode in ("fsdp", "tp_fsdp") and self.n_data > 1 \
+                and int(np.prod(shape)) >= self.fsdp_min_size:
+            fsdp_axis = _fsdp_axis(
+                shape, self.n_data,
+                skip_leading_layer_axis=self._is_stacked(names),
+                exclude=tp_axis)
+        spec = [None] * len(shape)
+        if tp_axis is not None:
+            spec[tp_axis] = MODEL_AXIS
+        if fsdp_axis is not None:
+            spec[fsdp_axis] = DATA_AXIS
+        if all(s is None for s in spec):
+            return P()
+        return P(*spec)
+
+    def opt_spec(self, names: Tuple[str, ...], shape: Tuple[int, ...]) -> P:
+        """PartitionSpec for an optimizer-state leaf (adam m/v mirror the
+        param tree; scalars replicate)."""
+        if self.shard_mode == "zero1":
+            # ZeRO-1: shard ONLY optimizer state (reference
+            # ZeroRedundancyOptimizer, build_components.py:250-256)
+            axis = _fsdp_axis(shape, self.n_data,
+                              skip_leading_layer_axis=self._is_stacked(names))
+            if int(np.prod(shape)) < self.fsdp_min_size:
+                axis = None
+            return _spec_with_axis(len(shape), axis, DATA_AXIS)
+        return self.param_spec(names, shape)
+
+    def batch_spec(self) -> P:
+        return P(DATA_AXIS)
+
+    # -- pytree placement ---------------------------------------------
+
+    def _named(self, spec: P) -> NamedSharding:
+        return NamedSharding(self.mesh, spec)
+
+    def state_shardings(self, state: Params) -> Params:
+        """Shardings for a full train state {trainable, frozen, opt_state,
+        step, rng}."""
+        def spec_of(path, leaf):
+            names = _path_names(path)
+            shape = tuple(getattr(leaf, "shape", ()))
+            if not shape or not names:
+                return self._named(P())
+            if names[0] in ("trainable", "frozen"):
+                return self._named(self.param_spec(names[1:], shape))
+            if names[0] == "opt_state":
+                return self._named(self.opt_spec(names[1:], shape))
+            return self._named(P())
+
+        return jax.tree_util.tree_map_with_path(spec_of, state)
+
+    def shard_state(self, state: Params) -> Params:
+        return jax.device_put(state, self.state_shardings(state))
+
+    def params_shardings(self, params: Params) -> Params:
+        def spec_of(path, leaf):
+            return self._named(self.param_spec(
+                _path_names(path), tuple(getattr(leaf, "shape", ()))))
+
+        return jax.tree_util.tree_map_with_path(spec_of, params)
+
+    def shard_params(self, params: Params) -> Params:
+        return jax.device_put(params, self.params_shardings(params))
+
+    def shard_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        """Place a per-process batch as a globally-sharded array.
+
+        Single-process: a straight device_put with the data-axis sharding.
+        Multi-process: each process contributes its local rows
+        (``jax.make_array_from_process_local_data``), replacing the
+        reference's DistributedSampler index sharding.
+        """
+        def put(x):
+            sharding = self._named(
+                P(*([DATA_AXIS] + [None] * (np.ndim(x) - 1))))
+            if jax.process_count() == 1:
+                return jax.device_put(x, sharding)
+            return jax.make_array_from_process_local_data(sharding, x)
+
+        return jax.tree_util.tree_map(put, batch)
+
+
+def build_mesh_plan(shard_mode: str = "dp", *, tp: int = 1, sp: int = 1,
+                    devices=None) -> MeshPlan:
+    """Convenience: mesh spanning all devices + plan for ``shard_mode``."""
+    mesh = make_mesh(data=-1, seq=sp, model=tp, devices=devices)
+    return MeshPlan(mesh=mesh, shard_mode=shard_mode)
