@@ -1,0 +1,33 @@
+"""Pretrained-weight loading (torch-free).
+
+The reference loads pretrained weights for every model family through three
+torch-based loaders (Models/GPT2/load_weights.py:110,
+Models/Llama/load_weights_llama2.py:74, Models/Llama/load_weights_llama3.py:88).
+Here the same name maps are reproduced as pure numpy -> jax conversions:
+state dicts come from safetensors/npz/pickle files read WITHOUT torch, and
+each converted leaf is ``jax.device_put`` directly onto its target sharding
+so large models never materialize unsharded on one chip (SURVEY.md §7
+"Hard parts": 8B-scale weight loading).
+"""
+
+from building_llm_from_scratch_tpu.weights.mappings import (
+    convert_gpt2_state_dict,
+    convert_llama_hf_state_dict,
+    convert_llama_meta_state_dict,
+)
+from building_llm_from_scratch_tpu.weights.fetch import (
+    HF_GPT2_REPOS,
+    HF_LLAMA_FILES,
+    load_hf_weights,
+    load_state_dict_file,
+)
+
+__all__ = [
+    "convert_gpt2_state_dict",
+    "convert_llama_hf_state_dict",
+    "convert_llama_meta_state_dict",
+    "HF_GPT2_REPOS",
+    "HF_LLAMA_FILES",
+    "load_hf_weights",
+    "load_state_dict_file",
+]

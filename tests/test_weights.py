@@ -1,0 +1,247 @@
+"""Pretrained-weight loading tests.
+
+Golden-logit parity (SURVEY.md §4 "golden-logit parity vs HF checkpoints"):
+random-initialized ``transformers`` models built OFFLINE from configs serve
+as the oracle — their state dicts have the exact HF naming/fusing the real
+checkpoints use, and their torch forward gives reference logits. Loading
+those state dicts through our converters must reproduce the logits.
+
+Also covers: the Meta-naming (w2/w3 swap) map, the weight-tying fallback,
+shard-aware device_put, and the torch-free .pth / safetensors readers
+against files written by torch itself.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from building_llm_from_scratch_tpu.configs import ModelConfig
+from building_llm_from_scratch_tpu.models import forward
+from building_llm_from_scratch_tpu.weights import (
+    convert_gpt2_state_dict,
+    convert_llama_hf_state_dict,
+    convert_llama_meta_state_dict,
+    load_state_dict_file,
+)
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+def _np_sd(model) -> dict:
+    return {k: v.detach().cpu().numpy() for k, v in model.state_dict().items()}
+
+
+# ---------------------------------------------------------------------------
+# GPT-2 golden logits
+# ---------------------------------------------------------------------------
+
+GPT2_TINY = ModelConfig(
+    name="gpt2-tiny", vocab_size=96, context_length=32, emb_dim=32,
+    n_heads=2, n_layers=3, hidden_dim=128, n_kv_groups=2,
+    norm="layernorm", positional="learned", activation="gelu",
+    qkv_bias=True, attn_out_bias=True, mlp_bias=True, norm_bias=True,
+    drop_rate=0.0, dtype="fp32")
+
+
+@pytest.fixture(scope="module")
+def gpt2_oracle():
+    from transformers import GPT2Config, GPT2LMHeadModel
+
+    torch.manual_seed(0)
+    hf = GPT2LMHeadModel(GPT2Config(
+        vocab_size=96, n_positions=32, n_embd=32, n_layer=3, n_head=2,
+        activation_function="gelu",           # exact-erf, matching ops.gelu
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+        layer_norm_epsilon=1e-5))
+    hf.eval()
+    return hf
+
+
+def test_gpt2_golden_logits(gpt2_oracle):
+    """Fused-QKV split + Conv1D layout + tied head reproduce HF logits.
+
+    The reference's GPT-2 loader is broken (VERDICT §2.3 #3 — wrong attr
+    names), so torch-HF itself is the oracle, not the reference mapping.
+    """
+    params = convert_gpt2_state_dict(_np_sd(gpt2_oracle), GPT2_TINY)
+    x = np.array([[1, 5, 9, 2, 44, 91, 3, 17]], np.int32)
+    with torch.no_grad():
+        want = gpt2_oracle(torch.tensor(x, dtype=torch.long)).logits.numpy()
+    got = np.asarray(forward(params, GPT2_TINY, x))
+    np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-4)
+
+
+def test_gpt2_requires_qkv_bias_config():
+    with pytest.raises(ValueError, match="qkv_bias=True"):
+        convert_gpt2_state_dict({}, GPT2_TINY.replace(qkv_bias=False))
+
+
+def test_gpt2_shape_mismatch_raises(gpt2_oracle):
+    sd = _np_sd(gpt2_oracle)
+    sd["transformer.h.0.attn.c_attn.weight"] = np.zeros((8, 24), np.float32)
+    with pytest.raises(ValueError, match="Shape mismatch"):
+        convert_gpt2_state_dict(sd, GPT2_TINY)
+
+
+# ---------------------------------------------------------------------------
+# LLaMA golden logits (GQA + RoPE + SwiGLU + RMSNorm)
+# ---------------------------------------------------------------------------
+
+LLAMA_TINY = ModelConfig(
+    name="llama-tiny", vocab_size=96, context_length=64, emb_dim=32,
+    n_heads=4, n_layers=3, hidden_dim=64, n_kv_groups=2,
+    norm="rmsnorm", positional="rope", activation="swiglu",
+    rope_base=10_000.0, rmsnorm_eps=1e-5, drop_rate=0.0, dtype="fp32",
+    eos_id=2, eos_text="</s>")
+
+
+@pytest.fixture(scope="module")
+def llama_oracle():
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    torch.manual_seed(0)
+    hf = LlamaForCausalLM(LlamaConfig(
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-5, rope_theta=10_000.0,
+        tie_word_embeddings=False, attention_bias=False, mlp_bias=False,
+        attention_dropout=0.0))
+    hf.eval()
+    return hf
+
+
+def test_llama_hf_golden_logits(llama_oracle):
+    params = convert_llama_hf_state_dict(_np_sd(llama_oracle), LLAMA_TINY)
+    x = np.array([[3, 11, 7, 2, 64, 95, 0, 33, 12, 8]], np.int32)
+    with torch.no_grad():
+        want = llama_oracle(torch.tensor(x, dtype=torch.long)).logits.numpy()
+    got = np.asarray(forward(params, LLAMA_TINY, x))
+    np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-4)
+
+
+def test_llama_weight_tying_fallback(llama_oracle):
+    """No lm_head.weight -> head ties to the embedding
+    (reference load_weights_llama3.py:81-85)."""
+    sd = _np_sd(llama_oracle)
+    del sd["lm_head.weight"]
+    params = convert_llama_hf_state_dict(sd, LLAMA_TINY)
+    np.testing.assert_array_equal(
+        np.asarray(params["head"]["weight"]),
+        sd["model.embed_tokens.weight"].T)
+
+
+def _to_meta_naming(hf_sd: dict, n_layers: int) -> dict:
+    """Rename an HF llama state dict into Meta's consolidated naming,
+    including Meta's w1=gate / w3=up / w2=down layout that produces the
+    reference's 'swap' (load_weights_llama2.py:55-63)."""
+    meta = {
+        "tok_embeddings.weight": hf_sd["model.embed_tokens.weight"],
+        "norm.weight": hf_sd["model.norm.weight"],
+        "output.weight": hf_sd["lm_head.weight"],
+    }
+    for l in range(n_layers):
+        h = f"model.layers.{l}"
+        m = f"layers.{l}"
+        meta[f"{m}.attention.wq.weight"] = hf_sd[f"{h}.self_attn.q_proj.weight"]
+        meta[f"{m}.attention.wk.weight"] = hf_sd[f"{h}.self_attn.k_proj.weight"]
+        meta[f"{m}.attention.wv.weight"] = hf_sd[f"{h}.self_attn.v_proj.weight"]
+        meta[f"{m}.attention.wo.weight"] = hf_sd[f"{h}.self_attn.o_proj.weight"]
+        meta[f"{m}.feed_forward.w1.weight"] = hf_sd[f"{h}.mlp.gate_proj.weight"]
+        meta[f"{m}.feed_forward.w3.weight"] = hf_sd[f"{h}.mlp.up_proj.weight"]
+        meta[f"{m}.feed_forward.w2.weight"] = hf_sd[f"{h}.mlp.down_proj.weight"]
+        meta[f"{m}.attention_norm.weight"] = hf_sd[f"{h}.input_layernorm.weight"]
+        meta[f"{m}.ffn_norm.weight"] = hf_sd[f"{h}.post_attention_layernorm.weight"]
+    return meta
+
+
+def test_llama_meta_naming_matches_hf_naming(llama_oracle):
+    """The Meta-format converter (w2/w3 swap) and the HF-format converter
+    must produce identical param trees from equivalent checkpoints."""
+    hf_sd = _np_sd(llama_oracle)
+    from_hf = convert_llama_hf_state_dict(hf_sd, LLAMA_TINY)
+    from_meta = convert_llama_meta_state_dict(
+        _to_meta_naming(hf_sd, LLAMA_TINY.n_layers), LLAMA_TINY)
+    for (ka, a), (kb, b) in zip(
+            jax.tree_util.tree_flatten_with_path(from_hf)[0],
+            jax.tree_util.tree_flatten_with_path(from_meta)[0]):
+        assert ka == kb
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ---------------------------------------------------------------------------
+# Shard-aware load
+# ---------------------------------------------------------------------------
+
+def test_load_directly_onto_fsdp_sharding(llama_oracle):
+    """Leaves land on the mesh sharding at load time (SURVEY §7: 8B weights
+    must never materialize unsharded) with unchanged values."""
+    from building_llm_from_scratch_tpu.parallel import build_mesh_plan
+
+    plan = build_mesh_plan("fsdp")
+    sd = _np_sd(llama_oracle)
+    sharded = convert_llama_hf_state_dict(sd, LLAMA_TINY, plan=plan)
+    plain = convert_llama_hf_state_dict(sd, LLAMA_TINY)
+
+    gate = sharded["blocks"]["mlp"]["gate"]            # (L, 32, 64): 64 % 8 == 0
+    assert len(gate.sharding.device_set) == 8
+    for a, b in zip(jax.tree_util.tree_leaves(sharded),
+                    jax.tree_util.tree_leaves(plain)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ---------------------------------------------------------------------------
+# Torch-free file readers vs torch-written files
+# ---------------------------------------------------------------------------
+
+def test_torch_pth_reader_roundtrip(tmp_path):
+    torch.manual_seed(1)
+    sd = {
+        "a.weight": torch.randn(5, 3),
+        "b.weight": torch.randn(7).to(torch.bfloat16),
+        "c.ids": torch.arange(6, dtype=torch.int64).reshape(2, 3),
+    }
+    p = tmp_path / "ckpt.pth"
+    torch.save(sd, p)
+    got = load_state_dict_file(str(p))
+    assert set(got) == set(sd)
+    np.testing.assert_allclose(got["a.weight"], sd["a.weight"].numpy())
+    np.testing.assert_allclose(got["b.weight"].astype(np.float32),
+                               sd["b.weight"].float().numpy())
+    np.testing.assert_array_equal(got["c.ids"], sd["c.ids"].numpy())
+
+
+def test_safetensors_reader_roundtrip(tmp_path):
+    from safetensors.torch import save_file
+
+    torch.manual_seed(2)
+    sd = {
+        "x": torch.randn(4, 6),
+        "y": torch.randn(3, 2).to(torch.bfloat16),
+        "z": torch.arange(4, dtype=torch.int32),
+    }
+    p = tmp_path / "model.safetensors"
+    save_file(sd, str(p))
+    got = load_state_dict_file(str(p))
+    np.testing.assert_allclose(got["x"], sd["x"].numpy())
+    np.testing.assert_allclose(got["y"].astype(np.float32),
+                               sd["y"].float().numpy())
+    np.testing.assert_array_equal(got["z"], sd["z"].numpy())
+
+
+def test_load_hf_weights_from_local_dir(tmp_path, llama_oracle):
+    """End-to-end: --weights_dir file -> converted tree (llama3_2 path,
+    single safetensors file), without network."""
+    from safetensors.torch import save_file
+
+    from building_llm_from_scratch_tpu.weights import load_hf_weights
+
+    save_file(llama_oracle.state_dict(), str(tmp_path / "model.safetensors"))
+    params = load_hf_weights("llama3_2", "1B", LLAMA_TINY,
+                             weights_dir=str(tmp_path))
+    x = np.array([[3, 1, 4, 1, 5]], np.int32)
+    with torch.no_grad():
+        want = llama_oracle(torch.tensor(x, dtype=torch.long)).logits.numpy()
+    got = np.asarray(forward(params, LLAMA_TINY, x))
+    np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-4)
