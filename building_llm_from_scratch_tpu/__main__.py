@@ -1,0 +1,5 @@
+"""``python -m building_llm_from_scratch_tpu`` entry point."""
+
+from building_llm_from_scratch_tpu.main import run
+
+run()

@@ -1,0 +1,226 @@
+"""CLI flag surface + cross-flag validation.
+
+Parity with the reference ``args.py`` (args.py:38-99 flags, :8-35 checks),
+re-targeted at TPU hardware:
+
+  - ``--run_type single_chip|multi_chip`` replaces single_gpu/multi_gpu;
+  - the three wrapper flags (--use_fsdp / --use_zero_opt and their
+    exclusivity check, args.py:25-32) become ONE ``--shard_mode``
+    {dp,fsdp,zero1,tp,tp_fsdp} — mutually exclusive by construction;
+  - ``--mixed_precision`` accepts the full reference policy table
+    (datautils/mixed_precision.py:41-46) incl. bf16_hybrid;
+  - TPU/offline additions: --tokenizer_path, --weights_dir,
+    --byte_tokenizer, --tp, --target_context_length, --resume_from,
+    --profile, --seed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import warnings
+
+from building_llm_from_scratch_tpu.configs import MODEL_PARAMS_MAPPING
+from building_llm_from_scratch_tpu.parallel.sharding import SHARD_MODES
+
+
+def check_dependencies(need_hf: bool = False) -> None:
+    """Import-probe for required libraries (reference req_libraries.py:6-47).
+
+    Core deps (jax/optax/numpy) raise with install hints; asset-fetch deps
+    (tiktoken/huggingface_hub/safetensors) only when the run needs them.
+    """
+    core = {"jax": "jax", "optax": "optax", "numpy": "numpy"}
+    fetch = {"huggingface_hub": "huggingface_hub"}
+    for mod, pkg in core.items():
+        try:
+            __import__(mod)
+        except ImportError:
+            raise ImportError(
+                f"Please install '{pkg}' with `pip install {pkg}`")
+    if need_hf:
+        for mod, pkg in fetch.items():
+            try:
+                __import__(mod)
+            except ImportError:
+                raise ImportError(
+                    f"Please install '{pkg}' with `pip install {pkg}` "
+                    "(needed for --load_weights)")
+
+
+def perform_checks(args) -> None:
+    """Cross-flag validation (reference args.py:8-35)."""
+    if not args.warnings:
+        warnings.filterwarnings("ignore")
+
+    if not os.path.exists(args.data_dir):
+        raise FileNotFoundError(
+            f"Data directory '{args.data_dir}' does not exist.")
+
+    if args.num_params not in MODEL_PARAMS_MAPPING.get(args.model, []):
+        raise ValueError(
+            f"Unsupported model configuration: {args.model} with "
+            f"{args.num_params}. Supported sizes: "
+            f"{MODEL_PARAMS_MAPPING.get(args.model, [])}")
+
+    # analog of "FSDP requires multi-GPU" (args.py:25-26): a sharded mode on
+    # a single chip is a no-op at best
+    if args.run_type == "single_chip" and args.shard_mode != "dp":
+        raise ValueError(
+            f"--shard_mode {args.shard_mode} requires --run_type multi_chip.")
+
+    if args.tp > 1 and args.shard_mode not in ("tp", "tp_fsdp"):
+        raise ValueError(
+            "--tp > 1 requires --shard_mode tp or tp_fsdp.")
+    if args.shard_mode in ("tp", "tp_fsdp") and args.tp < 2:
+        raise ValueError(
+            f"--shard_mode {args.shard_mode} requires --tp >= 2.")
+
+    if args.finetune and args.dataset == "gutenberg":
+        raise ValueError(
+            "--finetune requires an instruction dataset (--dataset alpaca).")
+    if not args.finetune and args.dataset == "alpaca":
+        raise ValueError(
+            "--dataset alpaca requires --finetune.")
+
+    if args.use_lora and args.lora_rank < 1:
+        raise ValueError("--lora_rank must be >= 1.")
+
+    from building_llm_from_scratch_tpu.ops.attention import AVAILABLE_IMPLS
+
+    if args.attn_impl not in AVAILABLE_IMPLS:
+        raise ValueError(
+            f"--attn_impl {args.attn_impl} is not implemented yet; "
+            f"options: {AVAILABLE_IMPLS}")
+
+    if args.resume_from is not None and not os.path.isdir(args.resume_from):
+        raise FileNotFoundError(
+            f"--resume_from checkpoint '{args.resume_from}' does not exist.")
+
+    check_dependencies(need_hf=(args.load_weights and not args.weights_dir))
+
+
+def get_args(argv=None):
+    """Parse + validate CLI flags (reference args.py:38-99)."""
+    parser = argparse.ArgumentParser(
+        prog="building_llm_from_scratch_tpu",
+        description="TPU-native Large Language Model Training Configuration")
+
+    # Dataset and I/O paths
+    parser.add_argument("--data_dir", type=str, default="data",
+                        help="Path to the dataset directory.")
+    parser.add_argument("--output_dir", type=str, default="model_checkpoints",
+                        help="Directory to save model checkpoints.")
+
+    # Training configuration
+    parser.add_argument("--n_epochs", type=int, default=2,
+                        help="Number of training epochs.")
+    parser.add_argument("--batch_size", type=int, default=4,
+                        help="PER-PROCESS batch size for training.")
+    parser.add_argument("--lr", type=float, default=5e-4,
+                        help="Base (peak) learning rate.")
+    parser.add_argument("--warmup_steps", type=int, default=10,
+                        help="Number of warmup steps.")
+    parser.add_argument("--initial_lr", type=float, default=1e-5,
+                        help="Initial learning rate before warmup.")
+    parser.add_argument("--min_lr", type=float, default=1e-6,
+                        help="Minimum learning rate.")
+
+    # Logging & Evaluation
+    parser.add_argument("--print_sample_iter", type=int, default=10,
+                        help="Steps between printing sample outputs.")
+    parser.add_argument("--eval_freq", type=int, default=10,
+                        help="Evaluation frequency (in steps).")
+    parser.add_argument("--save_ckpt_freq", type=int, default=100,
+                        help="Checkpoint save frequency (in steps).")
+
+    # Model Configuration
+    parser.add_argument("--model", type=str, default="GPT2",
+                        choices=list(MODEL_PARAMS_MAPPING),
+                        help="Target model architecture.")
+    parser.add_argument("--num_params", type=str, default="124M",
+                        help="Model size identifier.")
+    parser.add_argument("--load_weights", action="store_true",
+                        help="Load pretrained HF weights.")
+    parser.add_argument("--weights_dir", type=str, default=None,
+                        help="Local directory holding the pretrained "
+                             "checkpoint files (offline alternative to the "
+                             "HF-hub download).")
+    parser.add_argument("--debug", action="store_true",
+                        help="Use a small model for debugging purposes.")
+    parser.add_argument("--target_context_length", type=int, default=1024,
+                        help="Clamp LLaMA context to this length with RoPE "
+                             "theta rescale (reference behavior); 0 keeps "
+                             "the native context.")
+
+    # Hardware / precision / parallelism
+    parser.add_argument("--run_type", type=str, default="single_chip",
+                        choices=["single_chip", "multi_chip"],
+                        help="Run on one chip or shard over the mesh.")
+    parser.add_argument("--shard_mode", type=str, default="dp",
+                        choices=list(SHARD_MODES),
+                        help="Parallelism strategy over the device mesh "
+                             "(replaces --use_fsdp/--use_zero_opt).")
+    parser.add_argument("--tp", type=int, default=1,
+                        help="Tensor-parallel degree (model mesh axis).")
+    parser.add_argument("--use_actv_ckpt", action="store_true",
+                        help="Enable activation checkpointing (jax.remat).")
+    parser.add_argument("--data_type", type=str, default="fp32",
+                        choices=["fp32", "fp16", "bf16"],
+                        help="Model precision data type.")
+    parser.add_argument("--mixed_precision", type=str, default=None,
+                        choices=["fp16", "bf16", "bf16_hybrid", "fp32"],
+                        help="Mixed-precision policy (param/compute/reduce "
+                             "dtypes; reference FSDP MixedPrecision table).")
+    parser.add_argument("--attn_impl", type=str, default="auto",
+                        choices=["auto", "xla", "flash", "pallas"],
+                        help="Attention implementation.")
+
+    # Fine-tuning & Dataset
+    parser.add_argument("--finetune", action="store_true",
+                        help="Enable instruction-finetuning mode.")
+    parser.add_argument("--dataset", type=str, default="gutenberg",
+                        choices=["gutenberg", "alpaca"],
+                        help="Dataset name.")
+
+    # LoRA
+    parser.add_argument("--use_lora", action="store_true",
+                        help="Enable LoRA fine-tuning.")
+    parser.add_argument("--lora_rank", type=int, default=64,
+                        help="LoRA rank.")
+    parser.add_argument("--lora_alpha", type=float, default=32,
+                        help="LoRA alpha.")
+
+    # Tokenizer (TPU/offline additions)
+    parser.add_argument("--tokenizer_path", type=str, default=None,
+                        help="Local tokenizer asset (sentencepiece/BPE "
+                             "model file) for LLaMA tokenizers.")
+    parser.add_argument("--byte_tokenizer", action="store_true",
+                        help="Fall back to the offline ByteTokenizer "
+                             "(debug/smoke runs).")
+
+    # Run management
+    parser.add_argument("--resume_from", type=str, default=None,
+                        help="Resume training from a checkpoint directory.")
+    parser.add_argument("--profile", action="store_true",
+                        help="Capture a jax.profiler trace of the first "
+                             "training steps into <output_dir>/profile.")
+    parser.add_argument("--profile_steps", type=int, default=10,
+                        help="Number of steps to profile with --profile.")
+    parser.add_argument("--seed", type=int, default=123,
+                        help="Global random seed.")
+
+    # Warnings & Logs
+    parser.add_argument("--warnings", action="store_true",
+                        help="Enable Python warnings.")
+
+    args = parser.parse_args(argv)
+    perform_checks(args)
+    return args
+
+
+if __name__ == "__main__":
+    parsed = get_args()
+    print("Arguments parsed and validated successfully:")
+    for k, v in vars(parsed).items():
+        print(f"  {k}: {v}")

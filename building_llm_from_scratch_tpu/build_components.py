@@ -1,0 +1,155 @@
+"""The component factory.
+
+Parity with the reference ``build_components.py:307-320``: one call
+assembles config + model params (+ pretrained weights + LoRA) + tokenizer
+from the parsed flags. Differences from the reference:
+
+  - no model/optimizer *objects* — params are pytrees and the optimizer is
+    built by the Trainer once the cosine horizon is known (train.py:155
+    computes it the same way);
+  - DDP/FSDP/Zero wrappers (build_components.py:142-182,243-258) become a
+    ``MeshPlan`` — sharding specs over one mesh;
+  - the rank-ordered download barrier dance (build_components.py:211-216)
+    becomes coordinator-first download + ``sync_global_devices``;
+  - errors propagate instead of being logged-and-swallowed
+    (reference defect §2.3: build_components.py:322-323 returns None on
+    failure and main crashes later on unpack).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+
+from building_llm_from_scratch_tpu.configs import ModelConfig, get_config
+from building_llm_from_scratch_tpu.data.tokenizers import build_tokenizer
+from building_llm_from_scratch_tpu.models import init_params
+from building_llm_from_scratch_tpu.models.lora import (
+    count_lora_params,
+    init_lora_params,
+)
+from building_llm_from_scratch_tpu.parallel import (
+    MeshPlan,
+    build_mesh_plan,
+    is_coordinator,
+    sync_global_devices,
+)
+from building_llm_from_scratch_tpu.training.precision import (
+    PrecisionPolicy,
+    get_policy,
+)
+from building_llm_from_scratch_tpu.utils.hf import login_hf
+from building_llm_from_scratch_tpu.utils.logging import setup_logger
+from building_llm_from_scratch_tpu.utils.memory import (
+    count_params,
+    estimate_memory_dynamic,
+    estimate_memory_static,
+)
+
+logger = setup_logger(__name__)
+
+
+@dataclasses.dataclass
+class Components:
+    """Everything a run needs (reference returns a 4-tuple,
+    build_components.py:317-320)."""
+
+    cfg: ModelConfig
+    params: Dict[str, Any]
+    lora_params: Optional[Dict[str, Any]]
+    tokenizer: Any
+    plan: Optional[MeshPlan]
+    policy: Optional[PrecisionPolicy]
+
+
+def build_config(args) -> ModelConfig:
+    """Flags -> ModelConfig (reference build_components.py:50-82)."""
+    return get_config(
+        args.model, args.num_params,
+        dtype=args.data_type,
+        # GPT-2 HF checkpoints carry QKV biases (build_components.py:69-70)
+        qkv_bias=True if (args.load_weights and args.model == "GPT2") else None,
+        use_actv_ckpt=args.use_actv_ckpt,
+        debug=args.debug,
+        target_context_length=(args.target_context_length or None),
+    ).replace(attn_impl=args.attn_impl)
+
+
+def build_plan(args) -> Optional[MeshPlan]:
+    """Flags -> MeshPlan (replaces multigpu_setup, build_components.py:142-182)."""
+    if args.run_type != "multi_chip":
+        return None
+    return build_mesh_plan(args.shard_mode, tp=args.tp)
+
+
+def build_params(args, cfg: ModelConfig, plan: Optional[MeshPlan],
+                 seed: int = 0) -> Dict[str, Any]:
+    """Initialize or load model params, placed on the plan's sharding.
+
+    Pretrained load order mirrors the reference's coordinator-first barrier
+    dance (build_components.py:211-216): process 0 downloads (populating the
+    shared cache), everyone else waits, then all processes convert.
+    """
+    if args.load_weights:
+        from building_llm_from_scratch_tpu.weights import load_hf_weights
+
+        if args.weights_dir is None:
+            login_hf()
+            if not is_coordinator():
+                sync_global_devices("weights_download")
+        params = load_hf_weights(args.model, args.num_params, cfg, plan=plan,
+                                 weights_dir=args.weights_dir)
+        if args.weights_dir is None and is_coordinator():
+            sync_global_devices("weights_download")
+        return params
+
+    params = init_params(cfg, jax.random.PRNGKey(seed))
+    if plan is not None:
+        params = plan.shard_params(params)
+    return params
+
+
+def build_components(args) -> Components:
+    """Assemble all run components from parsed flags."""
+    cfg = build_config(args)
+    plan = build_plan(args)
+    policy = get_policy(args.mixed_precision)
+
+    params = build_params(args, cfg, plan, seed=args.seed)
+
+    n_params = count_params(params)
+    if is_coordinator():
+        logger.info("Total parameters: %s", f"{n_params:,}")
+        logger.info("Estimated training memory (4N Adam rule): %.2f GB",
+                    estimate_memory_static(n_params, cfg.dtype))
+
+    lora_params = None
+    if args.use_lora:
+        logger.info("Using LoRA...")
+        lora_params = init_lora_params(cfg, params,
+                                       jax.random.PRNGKey(args.seed + 1),
+                                       rank=args.lora_rank)
+        if plan is not None:
+            # adapters are tiny — replicate them across the mesh
+            from jax.sharding import PartitionSpec
+
+            replicated = plan._named(PartitionSpec())
+            lora_params = jax.device_put(
+                lora_params,
+                jax.tree_util.tree_map(lambda _: replicated, lora_params))
+        n_lora = count_lora_params(lora_params)
+        if is_coordinator():
+            logger.info("Total trainable LoRA parameters: %s", f"{n_lora:,}")
+            logger.info("Runtime params+grads estimate: %.2f GB",
+                        estimate_memory_dynamic(n_params, n_lora, cfg.dtype))
+    elif is_coordinator():
+        logger.info("Runtime params+grads estimate: %.2f GB",
+                    estimate_memory_dynamic(n_params, n_params, cfg.dtype))
+
+    tokenizer = build_tokenizer(args.model, args.tokenizer_path,
+                                fallback_byte=args.byte_tokenizer)
+
+    return Components(cfg=cfg, params=params, lora_params=lora_params,
+                      tokenizer=tokenizer, plan=plan, policy=policy)

@@ -1,0 +1,146 @@
+"""The run orchestrator (reference main.py:37-193).
+
+One entry point drives the whole framework:
+
+  seed -> distributed init -> build components (config + params [+ HF
+  weights] [+ LoRA] + tokenizer + MeshPlan + precision policy) -> discover
+  training files -> build loader -> Trainer [-> resume] -> warm-up sample
+  -> train/finetune -> plot losses.pdf + peak-HBM log -> final export.
+
+TPU-first differences from the reference:
+  - no ``mp.spawn``/NCCL rendezvous (main.py:22-29,185-193): on TPU pods
+    each host runs this same command and ``jax.distributed.initialize``
+    auto-discovers peers; parallelism is the MeshPlan, not process wiring;
+  - run artifacts (losses.pdf, peak memory, final export) are written by
+    the coordinator process (the reference's ``rank == 0`` gating);
+  - ``--resume_from`` restores params + optimizer state + step — a path
+    the reference lacks entirely (SURVEY §5);
+  - ``--profile`` captures a jax.profiler trace of the first steps.
+
+Usage:  python -m building_llm_from_scratch_tpu --data_dir ... [flags]
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from building_llm_from_scratch_tpu.args import get_args
+from building_llm_from_scratch_tpu.build_components import build_components
+from building_llm_from_scratch_tpu.data.instruct import InstructLoader
+from building_llm_from_scratch_tpu.data.pretrain import PretrainLoader
+from building_llm_from_scratch_tpu.parallel import (
+    initialize_distributed,
+    is_coordinator,
+    sync_global_devices,
+)
+from building_llm_from_scratch_tpu.training.trainer import Trainer
+from building_llm_from_scratch_tpu.utils.io import discover_training_files
+from building_llm_from_scratch_tpu.utils.logging import setup_logger
+from building_llm_from_scratch_tpu.utils.memory import log_device_memory
+from building_llm_from_scratch_tpu.utils.plotting import plot_losses
+from building_llm_from_scratch_tpu.utils.seeding import set_seed
+
+logger = setup_logger("main")
+
+
+def main(args) -> Trainer:
+    """Run one training/finetuning job from parsed args; returns the
+    Trainer (with its loss history) for callers/tests."""
+    import jax
+
+    # 1. distributed runtime + reproducibility (reference main.py:49-58)
+    initialize_distributed()
+    set_seed(args.seed)
+
+    # 2. components (reference main.py:63)
+    comps = build_components(args)
+    cfg = comps.cfg
+
+    # 3. training files (reference main.py:68-81)
+    txt_files, json_files = discover_training_files(args.data_dir)
+    files = json_files if args.finetune else txt_files
+    if not files:
+        raise FileNotFoundError(
+            "No training files found in specified directory.")
+    if is_coordinator():
+        logger.info("Total training files detected: %d", len(files))
+
+    # 4. loader (reference main.py:86-111)
+    loader_kwargs = dict(
+        tokenizer=comps.tokenizer,
+        batch_size=args.batch_size,
+        max_length=cfg.context_length,
+        train_ratio=0.9,
+        process_index=jax.process_index(),
+        process_count=jax.process_count(),
+        seed=args.seed,
+    )
+    if args.finetune:
+        # pad id comes from the model config — fixing the reference's
+        # hardcoded GPT-2 pad id 50256 (defect §2.3 #8)
+        loader = InstructLoader(pad_token_id=cfg.eos_id,
+                                dataset_name=args.dataset, **loader_kwargs)
+    else:
+        loader = PretrainLoader(stride=cfg.context_length, **loader_kwargs)
+
+    # 5. output dir (reference main.py:116-117)
+    if is_coordinator():
+        os.makedirs(args.output_dir, exist_ok=True)
+    sync_global_devices("output_dir")
+
+    # 6. trainer (reference main.py:122-138); the warm-up sample
+    #    (main.py:143-145) runs inside the trainer once state exists
+    trainer = Trainer(
+        cfg, comps.params, comps.tokenizer, loader,
+        output_dir=args.output_dir,
+        peak_lr=args.lr, initial_lr=args.initial_lr, min_lr=args.min_lr,
+        warmup_steps=args.warmup_steps,
+        eval_freq=args.eval_freq, eval_iters=5,
+        print_sample_iter=args.print_sample_iter,
+        save_ckpt_freq=args.save_ckpt_freq,
+        lora_params=comps.lora_params,
+        lora_alpha=args.lora_alpha if args.use_lora else None,
+        lora_rank=args.lora_rank if args.use_lora else None,
+        policy=comps.policy, plan=comps.plan, seed=args.seed,
+        resume_from=args.resume_from,
+        warmup_sample=True,
+        profile_dir=(os.path.join(args.output_dir, "profile")
+                     if args.profile else None),
+        profile_steps=args.profile_steps,
+    )
+
+    # 7. train / finetune (reference main.py:150-157)
+    if args.finetune:
+        trainer.finetune_model(files, n_epochs=args.n_epochs)
+    else:
+        trainer.train_model(files, n_epochs=args.n_epochs)
+
+    # 8. plot + peak memory on the coordinator (reference main.py:162-166)
+    if is_coordinator():
+        if trainer.train_losses:
+            epochs_seen = np.linspace(0, args.n_epochs,
+                                      len(trainer.train_losses))
+            plot_losses(epochs_seen, trainer.track_tokens_seen,
+                        trainer.train_losses, trainer.val_losses,
+                        args.output_dir)
+        logger.info("Training complete. Final model saved.")
+        log_device_memory(logger, prefix="Peak device memory — ")
+
+    # 9. final checkpoint + single-file export (reference main.py:171-172)
+    trainer.save_checkpoint("final")
+    trainer.export_final("model_pg_final.npz")
+
+    # 10. barrier before exit (reference main.py:177-179)
+    sync_global_devices("run_end")
+    return trainer
+
+
+def run(argv=None) -> Trainer:
+    """Console entry: parse flags, run."""
+    return main(get_args(argv))
+
+
+if __name__ == "__main__":
+    run()

@@ -22,6 +22,10 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+# Implementations currently wired up; args.py validates --attn_impl against
+# this so unimplemented choices fail at flag time, not mid-run.
+AVAILABLE_IMPLS = ("auto", "xla")
+
 
 def causal_attention(
     q: jnp.ndarray,               # (B, Tq, Hq, D)
@@ -46,9 +50,10 @@ def causal_attention(
     assert Hq % Hkv == 0, "query heads must be a multiple of kv heads"
     G = Hq // Hkv
 
-    if impl not in ("auto", "xla"):
+    if impl not in AVAILABLE_IMPLS:
         raise NotImplementedError(
-            f"attention impl '{impl}' is not available yet; use 'auto'/'xla'")
+            f"attention impl '{impl}' is not available yet; "
+            f"options: {AVAILABLE_IMPLS}")
 
     if q_positions is None:
         # training path: q and kv are the same sequence

@@ -27,6 +27,17 @@ import numpy as np
 Params = Dict[str, Any]
 
 
+def _restore_dtype(arr: np.ndarray, dtype_name: str) -> np.ndarray:
+    """Recover the recorded dtype. np.load returns bf16 (and other
+    ml_dtypes) arrays as raw void bytes; a view restores them losslessly."""
+    target = np.dtype(dtype_name)        # ml_dtypes names resolve (jax loads it)
+    if arr.dtype == target:
+        return arr
+    if arr.dtype.kind == "V" and arr.dtype.itemsize == target.itemsize:
+        return arr.view(target)
+    return arr.astype(target)
+
+
 def _path_str(path) -> str:
     parts = []
     for p in path:
@@ -43,7 +54,18 @@ def _path_str(path) -> str:
 
 def save_checkpoint(ckpt_dir: str, state: Params,
                     extra_metadata: Optional[dict] = None) -> str:
-    """Write every leaf of ``state`` plus a manifest. Returns the dir."""
+    """Write every leaf of ``state`` plus a manifest. Returns the dir.
+
+    Each leaf goes through ``gather_full`` so fsdp/zero1-sharded state on a
+    multi-host mesh (non-addressable arrays, where a bare device_get
+    raises) is reassembled via process_allgather before process 0 writes —
+    the reference's FULL_STATE_DICT rank-0 gather (train.py:244-249).
+    Gathering happens ONE LEAF AT A TIME inside the loop (every process
+    iterates leaves in the same order, so the collectives line up) to keep
+    peak host RAM at one full leaf, not the whole state.
+    """
+    from building_llm_from_scratch_tpu.parallel.collectives import gather_full
+
     is_writer = jax.process_index() == 0
     leaves = jax.tree_util.tree_flatten_with_path(state)[0]
     if is_writer:
@@ -51,7 +73,7 @@ def save_checkpoint(ckpt_dir: str, state: Params,
     manifest = {"leaves": [], "metadata": extra_metadata or {}}
     for i, (path, leaf) in enumerate(leaves):
         name = f"leaf_{i:05d}"
-        arr = np.asarray(jax.device_get(leaf))
+        arr = np.asarray(gather_full(leaf))
         manifest["leaves"].append({
             "index": i,
             "path": _path_str(path),
@@ -91,8 +113,20 @@ def load_checkpoint(ckpt_dir: str, template_state: Params,
             raise ValueError(
                 f"Leaf path mismatch: template {_path_str(path)} vs "
                 f"checkpoint {meta['path']}")
+        tmpl_shape = tuple(getattr(tmpl, "shape", ()))
+        tmpl_dtype = str(getattr(tmpl, "dtype", ""))
+        if tuple(meta["shape"]) != tmpl_shape:
+            raise ValueError(
+                f"Checkpoint leaf '{meta['path']}' has shape "
+                f"{tuple(meta['shape'])} but the model expects {tmpl_shape} "
+                "— wrong model size/config for this checkpoint.")
+        if tmpl_dtype and meta["dtype"] != tmpl_dtype:
+            raise ValueError(
+                f"Checkpoint leaf '{meta['path']}' has dtype "
+                f"{meta['dtype']} but the model expects {tmpl_dtype} "
+                "— was the checkpoint written with a different --data_type?")
         arr = np.load(os.path.join(ckpt_dir, f"leaf_{meta['index']:05d}.npy"))
-        arr = arr.astype(meta["dtype"])
+        arr = _restore_dtype(arr, meta["dtype"])
         if shard is not None:
             loaded.append(jax.device_put(arr, shard))
         else:
@@ -106,11 +140,23 @@ def checkpoint_metadata(ckpt_dir: str) -> dict:
 
 
 def export_params(path: str, params: Params) -> str:
-    """Single-file params export (reference final .pth, main.py:171-172)."""
+    """Single-file params export (reference final .pth, main.py:171-172).
+
+    Like ``save_checkpoint``, each leaf passes through ``gather_full``
+    (leaf-at-a-time — all processes iterate in the same order) so
+    mesh-sharded params on multi-host runs reassemble before process 0
+    writes. Dtypes are recorded per array (``__dtype__.<key>`` entries)
+    because np.savez stores ml_dtypes arrays as raw void bytes."""
+    from building_llm_from_scratch_tpu.parallel.collectives import gather_full
+
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    arrays = {}
+    for p, leaf in flat:
+        key = _path_str(p)
+        arr = np.asarray(gather_full(leaf))
+        arrays[key] = arr
+        arrays[f"__dtype__.{key}"] = np.asarray(str(arr.dtype))
     if jax.process_index() == 0:
-        flat = jax.tree_util.tree_flatten_with_path(params)[0]
-        arrays = {_path_str(p): np.asarray(jax.device_get(l))
-                  for p, l in flat}
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         np.savez(path, **arrays)
     return path
@@ -125,5 +171,12 @@ def load_exported_params(path: str, template_params: Params) -> Params:
         key = _path_str(p)
         if key not in data:
             raise KeyError(f"Export missing parameter {key}")
-        leaves.append(jax.device_put(data[key]))
+        dtype_key = f"__dtype__.{key}"
+        # restore through the RECORDED dtype (falling back to the template
+        # for exports written before dtypes were recorded), then cast to the
+        # template — never reinterpret bits across same-width dtypes
+        recorded = (str(data[dtype_key]) if dtype_key in data
+                    else str(tmpl.dtype))
+        arr = _restore_dtype(data[key], recorded).astype(tmpl.dtype)
+        leaves.append(jax.device_put(arr))
     return jax.tree_util.tree_unflatten(treedef, leaves)

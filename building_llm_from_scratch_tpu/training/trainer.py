@@ -35,7 +35,9 @@ from building_llm_from_scratch_tpu.generate import (
 )
 from building_llm_from_scratch_tpu.models.lora import merge_lora
 from building_llm_from_scratch_tpu.training.checkpoint import (
+    checkpoint_metadata,
     export_params,
+    load_checkpoint,
     save_checkpoint,
 )
 from building_llm_from_scratch_tpu.training.optim import (
@@ -70,7 +72,11 @@ class Trainer:
                  lora_params: Optional[Dict[str, Any]] = None,
                  lora_alpha: Optional[float] = None,
                  lora_rank: Optional[int] = None,
-                 policy=None, plan=None, seed: int = 123):
+                 policy=None, plan=None, seed: int = 123,
+                 resume_from: Optional[str] = None,
+                 warmup_sample: bool = False,
+                 profile_dir: Optional[str] = None,
+                 profile_steps: int = 10):
         self.cfg = cfg
         self.tokenizer = tokenizer
         self.loader = loader
@@ -88,6 +94,11 @@ class Trainer:
         self.policy = policy
         self.plan = plan
         self.seed = seed
+        self.resume_from = resume_from
+        self.warmup_sample = warmup_sample
+        self.profile_dir = profile_dir
+        self.profile_steps = profile_steps
+        self._profiling = False
 
         if (lora_params is None) != (lora_rank is None):
             raise ValueError(
@@ -115,12 +126,19 @@ class Trainer:
     def _setup(self, total_steps: int):
         """Build optimizer/schedule/jitted steps once total steps are known
         (the reference computes its cosine horizon the same way,
-        train.py:155)."""
+        train.py:155). On resume the cosine horizon extends by the steps
+        already taken — the restored optax count continues from there, so a
+        horizon of only this run's steps would pin the whole run at min_lr."""
+        prev_steps = 0
+        if self.resume_from is not None:
+            prev_steps = int(checkpoint_metadata(self.resume_from)
+                             .get("global_step", 0))
+        horizon = total_steps + prev_steps
         self.lr_schedule = warmup_cosine_schedule(
             self.opt_hparams["peak_lr"], self.opt_hparams["initial_lr"],
             self.opt_hparams["min_lr"], self.opt_hparams["warmup_steps"],
-            total_steps)
-        self.optimizer = build_optimizer(total_steps=total_steps,
+            horizon)
+        self.optimizer = build_optimizer(total_steps=horizon,
                                          schedule=self.lr_schedule,
                                          **self.opt_hparams)
         if self.use_lora:
@@ -131,6 +149,19 @@ class Trainer:
                                  jax.random.PRNGKey(self.seed), frozen)
         if self.plan is not None:
             state = self.plan.shard_state(state)
+        if self.resume_from is not None:
+            # restore the full train state (params + optax m/v + step + rng)
+            # onto the plan's shardings — the resume path the reference lacks
+            # (SURVEY §5 "No resume, no optimizer state")
+            shardings = (self.plan.state_shardings(state)
+                         if self.plan is not None else None)
+            state = load_checkpoint(self.resume_from, state,
+                                    shardings=shardings)
+            meta = checkpoint_metadata(self.resume_from)
+            self.global_step = int(meta.get("global_step", 0))
+            self.tokens_seen = int(meta.get("tokens_seen", 0))
+            logger.info("Resumed from %s at step %d (%d tokens seen)",
+                        self.resume_from, self.global_step, self.tokens_seen)
         self.state = state
         kw = dict(lora_alpha=self.lora_alpha, lora_rank=self.lora_rank,
                   policy=self.policy)
@@ -207,6 +238,16 @@ class Trainer:
                    val_batches_fn: Callable[[int], Any], epoch: int,
                    start_context: str):
         """One pass over one file's batches with cadence work."""
+        if self.warmup_sample and self.global_step == 0:
+            # warm-up sample before the first step (reference main.py:143-145)
+            self.generate_and_print_sample(start_context)
+            self.warmup_sample = False
+        if self.profile_dir is not None and not self._profiling:
+            # --profile: jax.profiler trace of the first training steps
+            # (SURVEY §5's TPU equivalent of the reference's memory introspection)
+            jax.profiler.start_trace(self.profile_dir)
+            self._profiling = True
+            self._profile_stop_at = self.global_step + self.profile_steps
         t_tokens, t_start = 0, time.perf_counter()
         for arrays in train_batches_fn(epoch):
             batch = self._device_batch(arrays)
@@ -216,6 +257,13 @@ class Trainer:
             self.tokens_seen += n_tok
             t_tokens += n_tok
             self.track_lrs.append(float(metrics["lr"]))
+
+            if self._profiling and self.global_step >= self._profile_stop_at:
+                jax.profiler.stop_trace()
+                self._profiling = False
+                self.profile_dir = None
+                logger.info("Profiler trace captured (%d steps)",
+                            self.profile_steps)
 
             if self.global_step % self.eval_freq == 0:
                 train_loss, val_loss = self.evaluate_model(
@@ -237,6 +285,11 @@ class Trainer:
 
             if self.global_step % self.save_ckpt_freq == 0:
                 self.save_checkpoint(str(self.global_step))
+
+    def _stop_profiler(self):
+        if self._profiling:
+            jax.profiler.stop_trace()
+            self._profiling = False
 
     def train_model(self, files: Sequence[str], n_epochs: int,
                     start_context: str = "Every effort moves you"):
@@ -264,6 +317,8 @@ class Trainer:
         except KeyboardInterrupt:
             self.save_checkpoint("interrupted")
             raise
+        finally:
+            self._stop_profiler()
         return self
 
     def finetune_model(self, files: Sequence[str], n_epochs: int):
@@ -298,6 +353,8 @@ class Trainer:
         except KeyboardInterrupt:
             self.save_checkpoint("interrupted")
             raise
+        finally:
+            self._stop_profiler()
         return self
 
     def export_final(self, filename: str = "model_pg_final.npz") -> str:

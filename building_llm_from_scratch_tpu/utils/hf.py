@@ -1,0 +1,37 @@
+"""HuggingFace Hub authentication (reference utils.py:196-213).
+
+Reads the access token from ``config_hf.json`` (same file name/key as the
+reference, ``{"HF_ACCESS_TOKEN": "..."}``) and logs into the hub — needed
+for the gated meta-llama weight/tokenizer downloads. Failures are logged,
+not raised, matching the reference (runs with local assets don't need it).
+"""
+
+from __future__ import annotations
+
+import json
+
+from building_llm_from_scratch_tpu.utils.logging import setup_logger
+
+logger = setup_logger(__name__)
+
+
+def login_hf(config_path: str = "config_hf.json") -> bool:
+    """Log into HF hub with the token from ``config_path``; True on success."""
+    try:
+        with open(config_path, "r", encoding="utf-8") as f:
+            config = json.load(f)
+        access_token = config.get("HF_ACCESS_TOKEN", None)
+        assert access_token, "HF_ACCESS_TOKEN not found in config."
+
+        from huggingface_hub import login
+
+        login(token=access_token)
+        logger.info("Logged into Hugging Face Hub.")
+        return True
+    except FileNotFoundError:
+        logger.error("'%s' not found. Copy config_hf.json.example to "
+                     "config_hf.json and fill in your access token (the "
+                     "real file is gitignored).", config_path)
+    except Exception as e:  # noqa: BLE001 — parity: log, don't crash
+        logger.error("Error logging into Hugging Face: %s", e)
+    return False

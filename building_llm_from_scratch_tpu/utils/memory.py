@@ -33,6 +33,14 @@ def estimate_memory_static(n_params: int, dtype: str = "fp32",
     return mult * n_params * DTYPE_BYTES[dtype] / 1024**3
 
 
+def estimate_memory_dynamic(n_params: int, n_trainable: int,
+                            dtype: str = "fp32") -> float:
+    """Dynamic params+grads estimate in GB (reference utils.py:131-144:
+    parameters + gradients-for-trainables + buffers; this framework keeps
+    no torch-style buffers — RoPE/mask constants live in the jit program)."""
+    return (n_params + n_trainable) * DTYPE_BYTES[dtype] / 1024**3
+
+
 def device_memory_stats(device: Optional[jax.Device] = None) -> Dict[str, int]:
     """Best-effort HBM stats for one device (bytes)."""
     device = device or jax.local_devices()[0]

@@ -1,0 +1,55 @@
+"""Checkpoint validation + dtype fidelity (ADVICE round-1 items)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from building_llm_from_scratch_tpu.training.checkpoint import (
+    export_params,
+    load_checkpoint,
+    load_exported_params,
+    save_checkpoint,
+)
+
+
+def test_load_rejects_wrong_shape(tmp_path):
+    state = {"w": jnp.ones((4, 4)), "b": jnp.zeros((4,))}
+    save_checkpoint(str(tmp_path / "ck"), state)
+    bigger = {"w": jnp.ones((8, 8)), "b": jnp.zeros((8,))}
+    with pytest.raises(ValueError, match="shape"):
+        load_checkpoint(str(tmp_path / "ck"), bigger)
+
+
+def test_load_rejects_wrong_dtype(tmp_path):
+    state = {"w": jnp.ones((4, 4), jnp.float32)}
+    save_checkpoint(str(tmp_path / "ck"), state)
+    with pytest.raises(ValueError, match="dtype"):
+        load_checkpoint(str(tmp_path / "ck"),
+                        {"w": jnp.ones((4, 4), jnp.bfloat16)})
+
+
+def test_bf16_checkpoint_roundtrip(tmp_path):
+    state = {"w": (jnp.arange(12, dtype=jnp.float32) / 7.0
+                   ).astype(jnp.bfloat16).reshape(3, 4),
+             "n": jnp.asarray(3, jnp.int32)}
+    save_checkpoint(str(tmp_path / "ck"), state)
+    got = load_checkpoint(str(tmp_path / "ck"), jax.tree_util.tree_map(
+        jnp.zeros_like, state))
+    assert got["w"].dtype == jnp.bfloat16
+    np.testing.assert_array_equal(np.asarray(got["w"], np.float32),
+                                  np.asarray(state["w"], np.float32))
+    assert int(got["n"]) == 3
+
+
+def test_bf16_export_roundtrip(tmp_path):
+    params = {"head": {"weight": (jnp.arange(8, dtype=jnp.float32)
+                                  ).astype(jnp.bfloat16).reshape(2, 4)}}
+    p = str(tmp_path / "m.npz")
+    export_params(p, params)
+    got = load_exported_params(p, jax.tree_util.tree_map(jnp.zeros_like,
+                                                         params))
+    assert got["head"]["weight"].dtype == jnp.bfloat16
+    np.testing.assert_array_equal(
+        np.asarray(got["head"]["weight"], np.float32),
+        np.asarray(params["head"]["weight"], np.float32))
