@@ -12,8 +12,10 @@ from building_llm_from_scratch_tpu.training.precision import (
 )
 from building_llm_from_scratch_tpu.training.train_step import (
     cross_entropy_loss,
+    cross_entropy_sums,
     init_train_state,
     make_eval_step,
+    make_sharded_train_step,
     make_train_step,
 )
 from building_llm_from_scratch_tpu.training.checkpoint import (
@@ -32,8 +34,10 @@ __all__ = [
     "cast_floating",
     "get_policy",
     "cross_entropy_loss",
+    "cross_entropy_sums",
     "init_train_state",
     "make_eval_step",
+    "make_sharded_train_step",
     "make_train_step",
     "export_params",
     "load_checkpoint",

@@ -31,6 +31,12 @@ class PrecisionPolicy:
     compute_dtype: str = "fp32"   # dtype params are cast to for fwd/bwd
     reduce_dtype: str = "fp32"    # dtype gradients are reduced in
     master_dtype: str = "fp32"    # dtype of the optimizer's master params
+    # dynamic loss scaling (fp16 only): fp16's 5-bit exponent underflows on
+    # typical LM gradients, so the loss is scaled up before backward and
+    # grads unscaled after; overflow steps are skipped and halve the scale,
+    # a streak of finite steps doubles it
+    init_loss_scale: float = 2.0 ** 15
+    scale_growth_interval: int = 2000
 
     @property
     def jax_compute_dtype(self):

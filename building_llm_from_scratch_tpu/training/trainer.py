@@ -47,6 +47,7 @@ from building_llm_from_scratch_tpu.training.optim import (
 from building_llm_from_scratch_tpu.training.train_step import (
     init_train_state,
     make_eval_step,
+    make_sharded_train_step,
     make_train_step,
 )
 from building_llm_from_scratch_tpu.utils.io import (
@@ -146,7 +147,8 @@ class Trainer:
         else:
             trainable, frozen = self._params, None
         state = init_train_state(trainable, self.optimizer,
-                                 jax.random.PRNGKey(self.seed), frozen)
+                                 jax.random.PRNGKey(self.seed), frozen,
+                                 policy=self.policy)
         if self.plan is not None:
             state = self.plan.shard_state(state)
         if self.resume_from is not None:
@@ -165,8 +167,17 @@ class Trainer:
         self.state = state
         kw = dict(lora_alpha=self.lora_alpha, lora_rank=self.lora_rank,
                   policy=self.policy)
-        self.train_step = make_train_step(self.cfg, self.optimizer,
-                                          lr_schedule=self.lr_schedule, **kw)
+        if (self.plan is not None and self.policy is not None
+                and self.policy.reduce_dtype != self.policy.compute_dtype
+                and self.plan.shard_mode in ("dp", "zero1")):
+            # the policy separates compute and reduce dtypes (bf16_hybrid):
+            # only the explicit shard_map step controls the psum dtype
+            self.train_step = make_sharded_train_step(
+                self.cfg, self.optimizer, self.plan,
+                lr_schedule=self.lr_schedule, **kw)
+        else:
+            self.train_step = make_train_step(
+                self.cfg, self.optimizer, lr_schedule=self.lr_schedule, **kw)
         self.eval_step = make_eval_step(self.cfg, **kw)
 
     def _device_batch(self, arrays: Sequence[np.ndarray]) -> Dict[str, Any]:
