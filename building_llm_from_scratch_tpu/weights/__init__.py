@@ -18,6 +18,7 @@ from building_llm_from_scratch_tpu.weights.mappings import (
 from building_llm_from_scratch_tpu.weights.fetch import (
     HF_GPT2_REPOS,
     HF_LLAMA_FILES,
+    download_hf_weights,
     load_hf_weights,
     load_state_dict_file,
 )
@@ -28,6 +29,7 @@ __all__ = [
     "convert_llama_meta_state_dict",
     "HF_GPT2_REPOS",
     "HF_LLAMA_FILES",
+    "download_hf_weights",
     "load_hf_weights",
     "load_state_dict_file",
 ]

@@ -272,6 +272,33 @@ def _resolve_files(repo_id: str, filenames: List[str],
             for f in filenames]
 
 
+def _repo_files(model: str, num_params: str) -> Tuple[str, List[str], str]:
+    """(repo_id, filenames, format) for a model family+size — the single
+    source of truth shared by download and convert paths."""
+    if model == "GPT2":
+        if num_params not in HF_GPT2_REPOS:
+            raise ValueError(
+                f"No GPT-2 model exists for size '{num_params}'. "
+                f"Options: {list(HF_GPT2_REPOS)}")
+        return HF_GPT2_REPOS[num_params], ["model.safetensors"], "gpt2"
+    if model not in HF_LLAMA_FILES:
+        raise ValueError(f"No pretrained weights mapping for model '{model}'")
+    return HF_LLAMA_FILES[model]
+
+
+def download_hf_weights(model: str, num_params: str,
+                        cache_dir: str = "hf_checkpoints") -> List[str]:
+    """Download-only: populate the local HF cache, no conversion.
+
+    Multi-host processes must call conversion (``load_hf_weights``) TOGETHER
+    — its ``device_put`` onto multi-host shardings is a collective. The
+    coordinator runs this local-only download before the barrier; everyone
+    converts after it (round-2 ADVICE medium #2).
+    """
+    repo, filenames, _ = _repo_files(model, num_params)
+    return _resolve_files(repo, filenames, None, cache_dir)
+
+
 def load_hf_weights(model: str, num_params: str, cfg: ModelConfig,
                     plan: Optional[Any] = None,
                     weights_dir: Optional[str] = None,
@@ -283,21 +310,12 @@ def load_hf_weights(model: str, num_params: str, cfg: ModelConfig,
     runs); otherwise files come from HF hub with cache-if-exists. ``plan``
     places each converted leaf straight onto its mesh sharding.
     """
-    if model == "GPT2":
-        if num_params not in HF_GPT2_REPOS:
-            raise ValueError(
-                f"No GPT-2 model exists for size '{num_params}'. "
-                f"Options: {list(HF_GPT2_REPOS)}")
-        paths = _resolve_files(HF_GPT2_REPOS[num_params],
-                               ["model.safetensors"], weights_dir, cache_dir)
+    repo_id, filenames, fmt = _repo_files(model, num_params)
+    paths = _resolve_files(repo_id, filenames, weights_dir, cache_dir)
+    if fmt == "gpt2":
         sd = load_state_dict_file(paths[0])
         logger.info("Loaded %d tensors for GPT2-%s", len(sd), num_params)
         return convert_gpt2_state_dict(sd, cfg, plan=plan)
-
-    if model not in HF_LLAMA_FILES:
-        raise ValueError(f"No pretrained weights mapping for model '{model}'")
-    repo_id, filenames, fmt = HF_LLAMA_FILES[model]
-    paths = _resolve_files(repo_id, filenames, weights_dir, cache_dir)
     if all(p.endswith(".safetensors") for p in paths):
         # lazy multi-shard view (load_weights_llama3.py:96-116 merges dicts
         # eagerly; here each tensor streams off disk only when converted)
