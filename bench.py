@@ -1,81 +1,263 @@
-"""Benchmark: tokens/sec/chip for GPT2-124M causal-LM pretraining.
+"""Benchmarks: tokens/sec/chip for the five BASELINE.json configs.
 
-BASELINE.json config #1 ("GPT2-124M single-device pretrain on Gutenberg,
-fp32, no LoRA/ckpt"). The reference publishes NO numbers (BASELINE.md), so
-``vs_baseline`` is measured against the first recorded figure for this repo
-(BASELINE.md "measured" table); 1.0 means parity with that record.
+Usage:
+  python bench.py            # headline: GPT2-124M pretrain bf16 (one JSON line)
+  python bench.py cfg1       # GPT2-124M fp32 bs4 ctx1024 (BASELINE #1)
+  python bench.py cfg2       # GPT2-774M bf16 + remat (BASELINE #2)
+  python bench.py cfg3       # LLaMA3.2-1B LoRA r8 SFT bf16 (BASELINE #3)
+  python bench.py cfg4       # LLaMA3-8B-arch fsdp slice (BASELINE #4, see note)
+  python bench.py cfg5       # LLaMA2-7B-arch zero1 slice (BASELINE #5, see note)
+  python bench.py trainer    # Trainer-loop path (vs raw-step, VERDICT r2 #3)
+  python bench.py all        # everything, one JSON line each
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The reference publishes NO numbers (BASELINE.md), so ``vs_baseline``
+compares against this repo's first recorded figure: headline/cfg1 against
+round-2's 37,039.6 (BASELINE.md history line), the rest against the round-3
+measured table in BASELINE.md. Configs #4/#5 target multi-chip
+pods this harness doesn't have; they run the exact fsdp/zero1 code paths on
+the largest model slice that fits one v5e chip (reduced layer count,
+recorded in the metric name) — the full-size sharding compiles+executes in
+``__graft_entry__.dryrun_multichip``.
 """
 
 from __future__ import annotations
 
 import json
+import sys
 import time
 
 import jax
 import numpy as np
 
-# First recorded tokens/sec/chip for this config on TPU v5e-1 (BASELINE.md).
-RECORDED_BASELINE = None  # set after the first measured run
+# First recorded tokens/sec/chip per config on TPU v5e-1 (BASELINE.md).
+RECORDED = {
+    "headline": 37039.6,   # r02's fp32 figure — the number to beat
+    "cfg1": 37039.6,       # r02 (threefry PRNG, pre-rbg)
+    "cfg2": 7601.0,        # r03 first recorded (BASELINE.md measured table)
+    "cfg3": 11062.9,       # r03
+    "cfg4": 17877.9,       # r03
+    "cfg5": 16330.3,       # r03
+    "trainer": 60781.6,    # r03 headline — the loop must keep up with it
+}
+
+# NOTE: on the axon remote backend jax.block_until_ready() returns at
+# dispatch time — only a literal device_get round-trips to the chip, so
+# all timing syncs use float()/device_get.
 
 
-def bench_gpt2_pretrain(batch_size: int = 4, warmup: int = 3,
-                        iters: int = 20) -> float:
-    # batch 4 == the reference's default (args.py:53); fp32 + no remat at
-    # batch 8 exceeds one v5e chip's 16GB HBM
-    from building_llm_from_scratch_tpu.configs import get_config
+def _time_steps(step, state, batch, warmup=3, iters=20):
+    for _ in range(max(1, warmup)):
+        state, metrics = step(state, batch)
+    float(metrics["loss"])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, metrics = step(state, batch)
+    float(metrics["loss"])
+    return time.perf_counter() - t0
+
+
+def _batch(cfg, batch_size, seed=0, sft_mask=False):
+    rng = np.random.default_rng(seed)
+    T = cfg.context_length
+    w = np.ones((batch_size, T), np.float32)
+    if sft_mask:
+        # instruction finetune: prompt tokens carry no loss (collator 0/1
+        # weights); mask the first half like a typical Alpaca prompt
+        w[:, : T // 2] = 0.0
+    return {
+        "inputs": rng.integers(0, cfg.vocab_size, (batch_size, T)).astype(
+            np.int32),
+        "targets": rng.integers(0, cfg.vocab_size, (batch_size, T)).astype(
+            np.int32),
+        "weights": w,
+    }
+
+
+def _pretrain_tps(cfg, batch_size, policy=None, warmup=3, iters=20,
+                  shard_mode=None, lora_rank=None, lora_alpha=None,
+                  sft_mask=False):
     from building_llm_from_scratch_tpu.models import init_params
+    from building_llm_from_scratch_tpu.parallel import build_mesh_plan
     from building_llm_from_scratch_tpu.training import (
         build_optimizer,
         init_train_state,
         make_train_step,
     )
 
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    if lora_rank is not None:
+        from building_llm_from_scratch_tpu.models.lora import init_lora_params
+
+        trainable = init_lora_params(cfg, params, jax.random.PRNGKey(1),
+                                     rank=lora_rank)
+        frozen = params
+    else:
+        trainable, frozen = params, None
+    opt = build_optimizer(total_steps=warmup + iters + 1)
+    state = init_train_state(trainable, opt, jax.random.PRNGKey(0),
+                             frozen=frozen, policy=policy)
+    batch = _batch(cfg, batch_size, sft_mask=sft_mask)
+    if shard_mode is not None:
+        plan = build_mesh_plan(shard_mode)
+        state = plan.shard_state(state)
+        batch = plan.shard_batch(batch)
+    step = make_train_step(cfg, opt, policy=policy, lora_rank=lora_rank,
+                           lora_alpha=lora_alpha)
+    dt = _time_steps(step, state, batch, warmup, iters)
+    return batch_size * cfg.context_length * iters / dt / jax.device_count()
+
+
+def bench_cfg1():
+    """BASELINE #1: GPT2-124M single-device pretrain, fp32, no LoRA/ckpt.
+
+    batch 4 == the reference's default (args.py:53); fp32 + no remat at
+    batch 8 exceeds one v5e chip's 16GB HBM.
+    """
+    from building_llm_from_scratch_tpu.configs import get_config
+
+    cfg = get_config("GPT2", "124M", dtype="fp32")
+    tps = _pretrain_tps(cfg, batch_size=4)
+    return "tokens/sec/chip GPT2-124M pretrain fp32 bs4 ctx1024", tps
+
+
+def bench_headline():
+    """Headline: GPT2-124M pretrain in bf16 — the dtype a TPU user would
+    actually run (MXU-native), per round-2 VERDICT #3."""
+    from building_llm_from_scratch_tpu.configs import get_config
+    from building_llm_from_scratch_tpu.training import get_policy
+
+    cfg = get_config("GPT2", "124M", dtype="fp32")
+    # bs4 measured faster than bs8 (63.4k vs 57.5k tok/s/chip): at bs8 the
+    # larger dropout-mask temps raise HBM pressure/fragmentation
+    tps = _pretrain_tps(cfg, batch_size=4, policy=get_policy("bf16"))
+    return "tokens/sec/chip GPT2-124M pretrain bf16 bs4 ctx1024", tps
+
+
+def bench_cfg2():
+    """BASELINE #2: GPT2-774M pretrain, bf16 + activation ckpt (remat)."""
+    from building_llm_from_scratch_tpu.configs import get_config
+    from building_llm_from_scratch_tpu.training import get_policy
+
+    cfg = get_config("GPT2", "774M", dtype="bf16", use_actv_ckpt=True)
+    tps = _pretrain_tps(cfg, batch_size=8, warmup=2, iters=10,
+                        policy=get_policy("bf16"))
+    return "tokens/sec/chip GPT2-774M pretrain bf16+remat bs8 ctx1024", tps
+
+
+def bench_cfg3():
+    """BASELINE #3: LLaMA3.2-1B instruction SFT with LoRA rank 8, bf16
+    (the second north-star metric)."""
+    from building_llm_from_scratch_tpu.configs import get_config
+    from building_llm_from_scratch_tpu.training import get_policy
+
+    # remat: without it the scan saves (L=16, B, T, hidden=8192) activation
+    # tensors for backward — 12GB+ of HLO temps, over one chip's 16GB
+    cfg = get_config("llama3_2", "1B", dtype="bf16", use_actv_ckpt=True,
+                     target_context_length=1024)
+    tps = _pretrain_tps(cfg, batch_size=8, warmup=2, iters=10,
+                        policy=get_policy("bf16"), lora_rank=8,
+                        lora_alpha=16, sft_mask=True)
+    return "tokens/sec/chip LLaMA3.2-1B LoRA-r8 SFT bf16 bs8 ctx1024", tps
+
+
+def bench_cfg4():
+    """BASELINE #4: LLaMA3-8B fsdp — 8B does not fit one 16GB chip, so this
+    runs the exact fsdp code path on the deepest 8B-architecture slice that
+    fits (full 4096-dim layers, reduced layer count; the name records it).
+    Full-size 8-way fsdp compiles+runs in dryrun_multichip."""
+    from building_llm_from_scratch_tpu.configs import get_config
+    from building_llm_from_scratch_tpu.training import get_policy
+
+    cfg = get_config("llama3", "8B", dtype="bf16", use_actv_ckpt=True,
+                     target_context_length=1024).replace(n_layers=2)
+    tps = _pretrain_tps(cfg, batch_size=4, warmup=2, iters=10,
+                        policy=get_policy("bf16"), shard_mode="fsdp")
+    return ("tokens/sec/chip LLaMA3-8B-arch[2/32 layers] SFT bf16 "
+            "fsdp bs4 ctx1024"), tps
+
+
+def bench_cfg5():
+    """BASELINE #5: LLaMA2-7B zero1 — same one-chip constraint as #4; runs
+    the zero1 (optimizer-state sharding) path on the deepest 7B-architecture
+    slice that fits."""
+    from building_llm_from_scratch_tpu.configs import get_config
+    from building_llm_from_scratch_tpu.training import get_policy
+
+    cfg = get_config("llama2", "7B", dtype="bf16", use_actv_ckpt=True,
+                     target_context_length=1024).replace(n_layers=4)
+    tps = _pretrain_tps(cfg, batch_size=4, warmup=2, iters=10,
+                        policy=get_policy("bf16"), shard_mode="zero1")
+    return ("tokens/sec/chip LLaMA2-7B-arch[4/32 layers] pretrain bf16 "
+            "zero1 bs4 ctx1024"), tps
+
+
+def bench_trainer(n_steps=60):
+    """The Trainer-loop path (cadence work, metric tracking, data pipeline)
+    — must be within ~5% of the raw-step headline (round-2 VERDICT #3)."""
+    import tempfile
+
+    from building_llm_from_scratch_tpu.configs import get_config
+    from building_llm_from_scratch_tpu.data import ByteTokenizer, PretrainLoader
+    from building_llm_from_scratch_tpu.models import init_params
+    from building_llm_from_scratch_tpu.training import Trainer, get_policy
+
     cfg = get_config("GPT2", "124M", dtype="fp32")
     params = init_params(cfg, jax.random.PRNGKey(0))
-    opt = build_optimizer(total_steps=warmup + iters + 1)
-    state = init_train_state(params, opt, jax.random.PRNGKey(0))
-    step = make_train_step(cfg, opt)
-
-    rng = np.random.default_rng(0)
-    T = cfg.context_length
-    batch = {
-        "inputs": rng.integers(0, cfg.vocab_size, (batch_size, T)).astype(
-            np.int32),
-        "targets": rng.integers(0, cfg.vocab_size, (batch_size, T)).astype(
-            np.int32),
-        "weights": np.ones((batch_size, T), np.float32),
-    }
-
-    # NOTE: on the axon remote backend jax.block_until_ready() returns at
-    # dispatch time — only a literal device_get round-trips to the chip, so
-    # all timing syncs use float()/device_get.
-    for _ in range(max(1, warmup)):
-        state, metrics = step(state, batch)
-    float(metrics["loss"])
-
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        state, metrics = step(state, batch)
-    float(metrics["loss"])
-    dt = time.perf_counter() - t0
-
-    tokens_per_step = batch_size * T
-    n_chips = jax.device_count()
-    return tokens_per_step * iters / dt / n_chips
+    tok = ByteTokenizer()
+    loader = PretrainLoader(tok, batch_size=4, max_length=cfg.context_length)
+    with tempfile.TemporaryDirectory() as d:
+        path = f"{d}/corpus.txt"
+        # enough bytes for > n_steps batches of 8x1024 tokens
+        with open(path, "w") as f:
+            f.write("the quick brown fox jumps over the lazy dog. "
+                    * (n_steps * 4 * 1024 // 44 + 200))
+        trainer = Trainer(cfg, params, tok, loader, output_dir=d,
+                          policy=get_policy("bf16"),
+                          eval_freq=20, eval_iters=1,
+                          print_sample_iter=10 ** 9, save_ckpt_freq=10 ** 9,
+                          warmup_steps=2)
+        trainer.train_model([path], n_epochs=1)
+        # drop the first window (compile); average the steady-state windows
+        tps_windows = trainer.throughput_tokens_per_s[1:]
+    tps = float(np.mean(tps_windows)) if tps_windows else 0.0
+    return "tokens/sec/chip GPT2-124M Trainer-loop bf16 bs4 ctx1024", tps
 
 
-def main():
-    tps = bench_gpt2_pretrain()
-    vs = tps / RECORDED_BASELINE if RECORDED_BASELINE else 1.0
+BENCHES = {
+    "headline": bench_headline,
+    "cfg1": bench_cfg1,
+    "cfg2": bench_cfg2,
+    "cfg3": bench_cfg3,
+    "cfg4": bench_cfg4,
+    "cfg5": bench_cfg5,
+    "trainer": bench_trainer,
+}
+
+
+def run(name: str):
+    metric, tps = BENCHES[name]()
+    rec = RECORDED.get(name)
     print(json.dumps({
-        "metric": "tokens/sec/chip GPT2-124M pretrain fp32 bs4 ctx1024",
+        "metric": metric,
         "value": round(tps, 1),
         "unit": "tokens/sec/chip",
-        "vs_baseline": round(vs, 3),
-    }))
+        "vs_baseline": round(tps / rec, 3) if rec else 1.0,
+    }), flush=True)
+
+
+def main(argv):
+    from building_llm_from_scratch_tpu.utils.seeding import (
+        configure_default_prng,
+    )
+
+    configure_default_prng()   # rbg PRNG: dropout at full speed (seeding.py)
+    which = argv[1] if len(argv) > 1 else "headline"
+    if which == "all":
+        for name in BENCHES:
+            run(name)
+    else:
+        run(which)
 
 
 if __name__ == "__main__":
-    main()
+    main(sys.argv)

@@ -114,8 +114,8 @@ def build_params(args, cfg: ModelConfig, plan: Optional[MeshPlan],
     params = init_params(cfg, jax.random.PRNGKey(seed))
     if plan is not None:
         # freshly initialized — nothing else references these buffers, so
-        # the donation-safety copy of shard_params is unnecessary
-        params = plan.place_params(params)
+        # the donation-safety copy is unnecessary
+        params = plan.shard_params(params, copy=False)
     return params
 
 

@@ -40,7 +40,10 @@ from building_llm_from_scratch_tpu.utils.io import discover_training_files
 from building_llm_from_scratch_tpu.utils.logging import setup_logger
 from building_llm_from_scratch_tpu.utils.memory import log_device_memory
 from building_llm_from_scratch_tpu.utils.plotting import plot_losses
-from building_llm_from_scratch_tpu.utils.seeding import set_seed
+from building_llm_from_scratch_tpu.utils.seeding import (
+    configure_default_prng,
+    set_seed,
+)
 
 logger = setup_logger("main")
 
@@ -52,6 +55,7 @@ def main(args) -> Trainer:
 
     # 1. distributed runtime + reproducibility (reference main.py:49-58)
     initialize_distributed()
+    configure_default_prng()
     set_seed(args.seed)
 
     # 2. components (reference main.py:63)

@@ -244,15 +244,18 @@ class MeshPlan:
 
         return jax.tree_util.tree_map_with_path(spec_of, params)
 
-    def shard_params(self, params: Params) -> Params:
+    def shard_params(self, params: Params, *, copy: bool = True) -> Params:
+        """Place a params pytree on the mesh.
+
+        ``copy=True`` (default) never aliases the caller's buffers — safe to
+        feed into donating steps. Pass ``copy=False`` ONLY for freshly
+        created params with no outside references (init/load paths), where
+        the donation-safety copy is pure transient-HBM waste.
+        """
+        if not copy:
+            return jax.device_put(params, self.params_shardings(params))
         return jax.tree_util.tree_map(
             self._put_fresh, params, self.params_shardings(params))
-
-    def place_params(self, params: Params) -> Params:
-        """Like ``shard_params`` but may alias the source buffers — for
-        freshly created params with no outside references (init/load paths),
-        where the donation-safety copy is pure waste."""
-        return jax.device_put(params, self.params_shardings(params))
 
     def shard_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
         """Place a per-process batch as a globally-sharded array.

@@ -24,6 +24,10 @@ from typing import Any, Dict, Optional
 import jax
 import numpy as np
 
+from building_llm_from_scratch_tpu.utils.logging import setup_logger
+
+logger = setup_logger(__name__)
+
 Params = Dict[str, Any]
 
 
@@ -116,6 +120,17 @@ def load_checkpoint(ckpt_dir: str, template_state: Params,
         tmpl_shape = tuple(getattr(tmpl, "shape", ()))
         tmpl_dtype = str(getattr(tmpl, "dtype", ""))
         if tuple(meta["shape"]) != tmpl_shape:
+            if meta["path"].endswith("rng"):
+                # PRNG keys are impl-specific (threefry (2,) vs rbg (4,)
+                # uint32); a checkpoint written under a different default
+                # impl cannot restore its dropout stream — keep the
+                # template's fresh key instead of bricking the resume
+                logger.warning(
+                    "Checkpoint rng leaf has shape %s but the current PRNG "
+                    "impl uses %s; keeping a fresh rng (dropout stream "
+                    "restarts).", tuple(meta["shape"]), tmpl_shape)
+                loaded.append(tmpl)
+                continue
             raise ValueError(
                 f"Checkpoint leaf '{meta['path']}' has shape "
                 f"{tuple(meta['shape'])} but the model expects {tmpl_shape} "

@@ -13,6 +13,24 @@ import random
 import numpy as np
 
 
+def configure_default_prng():
+    """Switch JAX's default PRNG from threefry to ``rbg`` on TPU.
+
+    Threefry keygen dominates dropout cost on TPU: GPT2-124M bf16 bs8
+    ctx1024 train steps measured 33.9k tok/s/chip under threefry vs 57.4k
+    under rbg (v5e-1, 2026-07) — the T^2 attention-dropout masks hash
+    millions of counters per step. ``rbg`` (XLA RngBitGenerator) is the
+    standard TPU-production choice; streams derived via fold_in remain
+    statistically sound for dropout. Called from the runtime entry points
+    (main, bench) — never on library import, so embedding applications keep
+    control of their own JAX config.
+    """
+    import jax
+
+    if jax.default_backend() == "tpu":
+        jax.config.update("jax_default_prng_impl", "rbg")
+
+
 def set_seed(seed: int = 123):
     """Seed host-side RNGs and return the root JAX PRNG key."""
     import jax

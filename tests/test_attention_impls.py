@@ -106,3 +106,50 @@ def test_pallas_matches_xla_on_tpu():
     want = np.asarray(causal_attention(q, k, v, impl="xla"), np.float32)
     got = np.asarray(causal_attention(q, k, v, impl="pallas"), np.float32)
     np.testing.assert_allclose(got, want, atol=2e-2, rtol=2e-2)
+
+
+@pytest.mark.skipif(jax.default_backend() != "tpu",
+                    reason="pallas flash kernel needs a real TPU")
+def test_pallas_gradients_match_xla_on_tpu():
+    """The tuned-block pallas path must be exact in the backward too (it
+    feeds real training steps when auto picks it at seq >= 2048)."""
+    q, k, v = _qkv(T=2048, Hq=4, Hkv=2, D=64, dtype=jnp.bfloat16)
+
+    def loss(impl, q, k, v):
+        out = causal_attention(q, k, v, impl=impl)
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    gw = jax.grad(lambda *a: loss("xla", *a), argnums=(0, 1, 2))(q, k, v)
+    gp = jax.grad(lambda *a: loss("pallas", *a), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gp, gw):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   atol=2e-1, rtol=5e-2)
+
+
+def test_auto_selection_policy():
+    """auto follows the measured table: xla for decode/q_positions, pallas
+    only on TPU at seq >= 2048, flash for long block-divisible training
+    shapes, xla otherwise."""
+    from building_llm_from_scratch_tpu.ops.attention import _resolve_impl
+
+    on_tpu = jax.default_backend() == "tpu"
+    # decode / chunked-prefill shapes pin to the exact oracle
+    assert _resolve_impl("auto", 1, 64, 64, None, jnp.asarray([5]), False,
+                         256) == "xla"
+    assert _resolve_impl("flash", 64, 64, 64, jnp.arange(64), None, False,
+                         256) == "xla"
+    assert _resolve_impl("pallas", 64, 64, 64, jnp.arange(64), None, False,
+                         256) == "xla"
+    # training shapes
+    assert _resolve_impl("auto", 1024, 1024, 64, None, None, False,
+                         256) == "flash"
+    expect_long = "pallas" if on_tpu else "flash"
+    assert _resolve_impl("auto", 2048, 2048, 64, None, None, False,
+                         256) == expect_long
+    # dropout disqualifies the pallas kernel
+    assert _resolve_impl("auto", 2048, 2048, 64, None, None, True,
+                         256) == "flash"
+    # short sequences stay exact
+    assert _resolve_impl("auto", 128, 128, 64, None, None, False,
+                         256) == "xla"

@@ -53,3 +53,18 @@ def test_bf16_export_roundtrip(tmp_path):
     np.testing.assert_array_equal(
         np.asarray(got["head"]["weight"], np.float32),
         np.asarray(params["head"]["weight"], np.float32))
+
+
+def test_rng_impl_change_keeps_fresh_key(tmp_path):
+    """A checkpoint written under a different default PRNG impl (threefry
+    (2,) vs rbg (4,) keys) must resume with a fresh rng + warning, not brick
+    the run on the shape cross-check (round-3 review finding)."""
+    state = {"w": jnp.ones((4, 4)),
+             "rng": jnp.zeros((4,), jnp.uint32)}       # rbg-shaped key
+    save_checkpoint(str(tmp_path / "ck"), state)
+    template = {"w": jnp.zeros((4, 4)),
+                "rng": jnp.asarray([7, 9], jnp.uint32)}  # threefry-shaped
+    got = load_checkpoint(str(tmp_path / "ck"), template)
+    np.testing.assert_array_equal(np.asarray(got["w"]), np.ones((4, 4)))
+    # the template's key survives untouched
+    np.testing.assert_array_equal(np.asarray(got["rng"]), [7, 9])
