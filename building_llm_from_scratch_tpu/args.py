@@ -76,6 +76,17 @@ def perform_checks(args) -> None:
         raise ValueError(
             f"--shard_mode {args.shard_mode} requires --tp >= 2.")
 
+    if args.sp > 1:
+        if args.run_type != "multi_chip":
+            raise ValueError("--sp > 1 requires --run_type multi_chip.")
+        if args.model == "GPT2":
+            # ring attention has no per-shard attention-dropout stream and
+            # GPT-2 configs train with dropout 0.1 (transformer.py raises
+            # the same constraint at trace time)
+            raise ValueError(
+                "--sp > 1 is not supported for GPT2 (attention dropout); "
+                "use a LLaMA-family model.")
+
     if args.finetune and args.dataset == "gutenberg":
         raise ValueError(
             "--finetune requires an instruction dataset (--dataset alpaca).")
@@ -171,6 +182,9 @@ def get_args(argv=None):
                              "(replaces --use_fsdp/--use_zero_opt).")
     parser.add_argument("--tp", type=int, default=1,
                         help="Tensor-parallel degree (model mesh axis).")
+    parser.add_argument("--sp", type=int, default=1,
+                        help="Sequence-parallel degree (seq mesh axis; "
+                             "ring attention for long contexts).")
     parser.add_argument("--use_actv_ckpt", action="store_true",
                         help="Enable activation checkpointing (jax.remat).")
     parser.add_argument("--data_type", type=str, default="fp32",

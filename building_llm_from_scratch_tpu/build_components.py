@@ -81,7 +81,7 @@ def build_plan(args) -> Optional[MeshPlan]:
     """Flags -> MeshPlan (replaces multigpu_setup, build_components.py:142-182)."""
     if args.run_type != "multi_chip":
         return None
-    return build_mesh_plan(args.shard_mode, tp=args.tp)
+    return build_mesh_plan(args.shard_mode, tp=args.tp, sp=args.sp)
 
 
 def build_params(args, cfg: ModelConfig, plan: Optional[MeshPlan],

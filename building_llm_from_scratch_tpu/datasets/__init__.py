@@ -1,0 +1,24 @@
+"""Dataset acquisition (reference layer L7, ``Datasets/``).
+
+Corpus bootstrap for the two advertised workloads: Gutenberg pretraining
+(``datasets/gutenberg.py``) and Alpaca instruction finetuning
+(``datasets/alpaca.py``). Each module is runnable:
+
+    python -m building_llm_from_scratch_tpu.datasets.alpaca --data_dir data
+    python -m building_llm_from_scratch_tpu.datasets.gutenberg \
+        --data_dir raw_txt --output_dir data
+"""
+
+from building_llm_from_scratch_tpu.datasets.alpaca import fetch_alpaca
+from building_llm_from_scratch_tpu.datasets.gutenberg import (
+    is_english,
+    pack_files,
+    strip_gutenberg_boilerplate,
+)
+
+__all__ = [
+    "fetch_alpaca",
+    "is_english",
+    "pack_files",
+    "strip_gutenberg_boilerplate",
+]

@@ -1,0 +1,68 @@
+"""Alpaca instruction-dataset fetch.
+
+Parity with ``/root/reference/Datasets/Alpaca/download.py:5-44``: download
+the Stanford Alpaca JSON once (cache-if-exists), validate it parses, and
+report the record count. The output file is what ``--finetune --dataset
+alpaca`` consumes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import List
+
+from building_llm_from_scratch_tpu.utils.logging import setup_logger
+
+logger = setup_logger(__name__)
+
+ALPACA_URL = ("https://raw.githubusercontent.com/tatsu-lab/stanford_alpaca/"
+              "main/alpaca_data.json")
+DEFAULT_FILENAME = "instruction-data-alpaca.json"
+
+
+def fetch_alpaca(file_path: str, url: str = ALPACA_URL) -> List[dict]:
+    """Download-if-missing + load (reference download.py:19-37).
+
+    Returns the parsed records so callers can chain straight into the
+    instruction loader; raises on malformed JSON instead of caching a bad
+    download (the temp-file rename keeps a failed fetch from poisoning the
+    cache).
+    """
+    if not os.path.exists(file_path):
+        from urllib import request
+
+        logger.info("Downloading from %s ...", url)
+        with request.urlopen(url) as resp:
+            text = resp.read().decode("utf-8")
+        json.loads(text)                    # validate BEFORE caching
+        tmp = file_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(text)
+        os.replace(tmp, file_path)
+        logger.info("Saved to %s", file_path)
+    else:
+        logger.info("File already exists at %s", file_path)
+    with open(file_path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    logger.info("Loaded %d records from %s", len(data), file_path)
+    return data
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Fetch the Stanford Alpaca instruction dataset")
+    parser.add_argument("--data_dir", type=str, default="data",
+                        help="Directory to place the dataset in.")
+    parser.add_argument("--url", type=str, default=ALPACA_URL)
+    args = parser.parse_args(argv)
+
+    os.makedirs(args.data_dir, exist_ok=True)
+    path = os.path.join(args.data_dir, DEFAULT_FILENAME)
+    data = fetch_alpaca(path, url=args.url)
+    return path, len(data)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,203 @@
+"""Gutenberg corpus preparation.
+
+Parity with ``/root/reference/Datasets/Gutenberg/prepare_dataset.py:9-61``
+and ``setup.sh``: walk a directory of raw Project Gutenberg ``.txt`` files,
+keep predominantly-English texts (ASCII-ratio test), strip the PG license
+boilerplate, squeeze blank-line runs, and pack everything into a few large
+``combined_N.txt`` files (<= ``max_size_mb`` each) joined by the
+``<|endoftext|>`` separator — the exact input shape ``--dataset gutenberg``
+pretraining consumes.
+
+Differences from the reference:
+  - ``strip_gutenberg_boilerplate`` is implemented here (the reference
+    imports ``gutenberg.src.cleanup.strip_headers`` from the cloned pgcorpus
+    repo, setup.sh:27) — same marker-scanning behavior, no external clone;
+  - the download step is a plain-urllib hook (``download_archive``) instead
+    of a hardcoded Google-Drive ``gdown`` call with a placeholder file id
+    (download.py:4 ships ``'GIVE YOUR FILE ID'``);
+  - files stream one at a time — packing never holds more than one book
+    plus the current output buffer in memory.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import zipfile
+from typing import Iterable, List, Optional
+
+from building_llm_from_scratch_tpu.utils.logging import setup_logger
+
+logger = setup_logger(__name__)
+
+EOT = "<|endoftext|>"
+
+# Project Gutenberg boilerplate delimiters. The opening marker ends the
+# license header; the closing marker starts the license footer. Older files
+# use the "SMALL PRINT" legalese block instead.
+_START_MARKERS = (
+    "*** START OF", "***START OF", "*END*THE SMALL PRINT",
+    "*END THE SMALL PRINT",
+)
+_END_MARKERS = (
+    "*** END OF", "***END OF", "End of the Project Gutenberg",
+    "End of The Project Gutenberg", "End of Project Gutenberg",
+)
+
+
+def is_english(text: str, threshold: float = 0.9) -> bool:
+    """ASCII-ratio language filter (reference prepare_dataset.py:9-11)."""
+    if not text:
+        return False
+    ascii_chars = sum(1 for c in text if ord(c) < 128)
+    return ascii_chars / len(text) > threshold
+
+
+def strip_gutenberg_boilerplate(text: str) -> str:
+    """Cut the PG license header/footer around the actual book text.
+
+    Scans for the standard delimiter lines (same convention the pgcorpus
+    ``strip_headers`` relies on); if a marker is absent the corresponding
+    side is left untouched, so non-PG text passes through unchanged.
+    """
+    lines = text.splitlines(keepends=True)
+    start = 0
+    end = len(lines)
+    # the opening marker legitimately appears only near the top; scanning
+    # the whole file could hit quoted markers inside the book text
+    for i, line in enumerate(lines[:600]):
+        if any(m in line for m in _START_MARKERS):
+            start = i + 1
+    for i in range(len(lines) - 1, max(start, len(lines) - 600) - 1, -1):
+        if any(m in lines[i] for m in _END_MARKERS):
+            end = i
+    return "".join(lines[start:end])
+
+
+def _read_text(path: str, fallback_encoding: str = "latin1") -> str:
+    """UTF-8 first, latin-1 fallback (reference prepare_dataset.py:25-31)."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            return f.read()
+    except UnicodeDecodeError:
+        logger.warning("UnicodeDecodeError: using %s for %s",
+                       fallback_encoding, path)
+        with open(path, "r", encoding=fallback_encoding) as f:
+            return f.read()
+
+
+def clean_book(text: str) -> str:
+    """Boilerplate strip + blank-line squeeze (prepare_dataset.py:37-38)."""
+    text = strip_gutenberg_boilerplate(text)
+    return re.sub(r"\n\s*\n", "\n\n", text)
+
+
+def pack_files(file_paths: Iterable[str], target_dir: str,
+               max_size_mb: int = 500, separator: str = EOT) -> int:
+    """Pack cleaned books into ``combined_N.txt`` files of <= max_size_mb.
+
+    Returns the number of combined files written (reference
+    prepare_dataset.py:14-61). Non-English books are skipped; books are
+    joined by ``separator`` so the pretrain loader's document-boundary
+    handling sees the same token the reference trains with.
+    """
+    os.makedirs(target_dir, exist_ok=True)
+    max_bytes = max_size_mb * 1024 * 1024
+    sep_bytes = len(separator.encode("utf-8"))
+
+    counter = 0
+    out = None
+    out_size = 0
+
+    def open_next():
+        nonlocal counter, out, out_size
+        counter += 1
+        path = os.path.join(target_dir, f"combined_{counter}.txt")
+        out = open(path, "w", encoding="utf-8")
+        out_size = 0
+
+    try:
+        for path in file_paths:
+            content = _read_text(path)
+            if not is_english(content):
+                logger.info("Skipping non-English file: %s", path)
+                continue
+            content = clean_book(content)
+            size = len(content.encode("utf-8"))
+            if out is None:
+                open_next()
+            elif out_size + sep_bytes + size > max_bytes:
+                out.close()
+                open_next()
+            if out_size > 0:
+                out.write(separator)
+                out_size += sep_bytes
+            out.write(content)
+            out_size += size
+    finally:
+        if out is not None:
+            out.close()
+    return counter
+
+
+def find_txt_files(data_dir: str) -> List[str]:
+    """All ``.txt`` files under ``data_dir``, recursively, sorted — the
+    same discovery rule the training entry point uses
+    (utils/io.discover_training_files)."""
+    from building_llm_from_scratch_tpu.utils.io import (
+        discover_training_files,
+    )
+
+    return discover_training_files(data_dir)[0]
+
+
+def download_archive(url: str, output_path: str,
+                     extract_dir: Optional[str] = None) -> str:
+    """Fetch a corpus archive and optionally unzip it (the step
+    setup.sh:12-21 performs with gdown + unzip). Skips the download when
+    ``output_path`` already exists (cache-if-exists, like the Alpaca
+    fetch)."""
+    if not os.path.exists(output_path):
+        from urllib import request
+
+        logger.info("Downloading %s -> %s", url, output_path)
+        with request.urlopen(url) as resp, open(output_path, "wb") as f:
+            f.write(resp.read())
+    else:
+        logger.info("Archive already exists at %s", output_path)
+    if extract_dir is not None and zipfile.is_zipfile(output_path):
+        with zipfile.ZipFile(output_path) as zf:
+            zf.extractall(extract_dir)
+        return extract_dir
+    return output_path
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Prepare Gutenberg text files for LLM pretraining")
+    parser.add_argument("--data_dir", type=str, required=True,
+                        help="Input directory containing raw .txt files.")
+    parser.add_argument("--output_dir", type=str, default="data",
+                        help="Output directory for combined files.")
+    parser.add_argument("--max_size_mb", type=int, default=500,
+                        help="Maximum size (MB) of each combined file.")
+    parser.add_argument("--archive_url", type=str, default=None,
+                        help="Optional corpus archive URL to download and "
+                             "unzip into --data_dir first.")
+    args = parser.parse_args(argv)
+
+    if args.archive_url:
+        os.makedirs(args.data_dir, exist_ok=True)
+        download_archive(args.archive_url,
+                         os.path.join(args.data_dir, "corpus.zip"),
+                         extract_dir=args.data_dir)
+    files = find_txt_files(args.data_dir)
+    logger.info("Found %d text file(s) to process.", len(files))
+    n = pack_files(files, args.output_dir, max_size_mb=args.max_size_mb)
+    logger.info("%d file(s) saved in: %s", n, os.path.abspath(args.output_dir))
+    return n
+
+
+if __name__ == "__main__":
+    main()

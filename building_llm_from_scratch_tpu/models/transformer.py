@@ -164,7 +164,8 @@ def _attention(cfg: ModelConfig, p: Params, x: jnp.ndarray,
                positions: Optional[jnp.ndarray],
                cache_kv: Optional[Tuple[jnp.ndarray, jnp.ndarray]],
                cache_len: Optional[jnp.ndarray],
-               rng: Optional[jax.Array], deterministic: bool):
+               rng: Optional[jax.Array], deterministic: bool,
+               sp_mesh=None):
     """Per-block attention; returns (out, new_cache_kv)."""
     B, Tq, D = x.shape
     hd, Hq, Hkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_groups
@@ -200,15 +201,29 @@ def _attention(cfg: ModelConfig, p: Params, x: jnp.ndarray,
         kv_length = None
         q_positions = None
 
-    out = causal_attention(
-        q, k, v,
-        q_positions=q_positions,
-        kv_length=kv_length,
-        dropout_rate=cfg.drop_rate,
-        dropout_rng=rng,
-        deterministic=deterministic,
-        impl=cfg.attn_impl,
-    )
+    if sp_mesh is not None and cache_kv is None:
+        # sequence parallelism: the ring schedule owns the communication.
+        # Attention dropout has no per-shard formulation here (same
+        # restriction as the fused pallas kernel).
+        if cfg.drop_rate > 0.0 and not deterministic:
+            raise ValueError(
+                "sequence parallelism (--sp) does not support attention "
+                "dropout; set drop_rate=0 for this model")
+        from building_llm_from_scratch_tpu.ops.ring_attention import (
+            ring_causal_attention,
+        )
+
+        out = ring_causal_attention(q, k, v, sp_mesh)
+    else:
+        out = causal_attention(
+            q, k, v,
+            q_positions=q_positions,
+            kv_length=kv_length,
+            dropout_rate=cfg.drop_rate,
+            dropout_rng=rng,
+            deterministic=deterministic,
+            impl=cfg.attn_impl,
+        )
     out = out.reshape(B, Tq, Hq * hd) @ p["wo"]
     if "bo" in p:
         out = out + p["bo"]
@@ -216,7 +231,8 @@ def _attention(cfg: ModelConfig, p: Params, x: jnp.ndarray,
 
 
 def _block(cfg: ModelConfig, p: Params, x: jnp.ndarray,
-           rope, positions, cache_kv, cache_len, rng, deterministic):
+           rope, positions, cache_kv, cache_len, rng, deterministic,
+           sp_mesh=None):
     """Pre-norm transformer block (reference GPT2.py:68-88, Llama3.py:159-181)."""
     if rng is not None:
         r_attn, r_res1, r_res2 = jax.random.split(rng, 3)
@@ -224,7 +240,7 @@ def _block(cfg: ModelConfig, p: Params, x: jnp.ndarray,
         r_attn = r_res1 = r_res2 = None
     h, new_cache = _attention(cfg, p["attn"], _norm(cfg, p["norm1"], x),
                               rope, positions, cache_kv, cache_len,
-                              r_attn, deterministic)
+                              r_attn, deterministic, sp_mesh=sp_mesh)
     x = x + _dropout(h, cfg.drop_rate, r_res1, deterministic)
     h = _mlp(cfg, p["mlp"], _norm(cfg, p["norm2"], x))
     x = x + _dropout(h, cfg.drop_rate, r_res2, deterministic)
@@ -258,10 +274,17 @@ def _embed(cfg: ModelConfig, params: Params, tokens: jnp.ndarray,
 
 def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray, *,
             rng: Optional[jax.Array] = None,
-            deterministic: bool = True) -> jnp.ndarray:
+            deterministic: bool = True,
+            sp_mesh=None) -> jnp.ndarray:
     """Training/eval forward over full sequences.
 
     tokens: (B, T) int32.  Returns fp32 logits (B, T, V).
+
+    ``sp_mesh``: a Mesh whose ``seq`` axis is > 1 switches attention to the
+    ring schedule (ops/ring_attention.py) — sequence parallelism for
+    long-context training. Everything else (embeddings, norms, MLPs, loss)
+    is token-local, so GSPMD shards it over the seq axis from the batch
+    sharding alone; only attention needs the explicit ring.
     """
     L = cfg.n_layers
     rope = _rope_tables(cfg)
@@ -278,7 +301,8 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray, *,
     def body(carry, layer):
         p, lrng = layer
         r = None if deterministic else lrng
-        y, _ = _block(cfg, p, carry, rope, None, None, None, r, deterministic)
+        y, _ = _block(cfg, p, carry, rope, None, None, None, r, deterministic,
+                      sp_mesh=sp_mesh)
         return y, None
 
     if cfg.use_actv_ckpt:

@@ -1,0 +1,133 @@
+"""Ring attention: causal attention with the sequence sharded over a mesh
+axis (sequence/context parallelism).
+
+Long-context training the reference cannot express: its attention always
+materializes (or flash-scans) the full sequence on ONE device, so context
+length is capped by a single GPU's memory (reference
+Models/Llama/Llama3.py:108-155 — full-sequence GQA per device). Here the
+sequence axis is sharded over ``SEQ_AXIS``; each device holds a T/S block of
+Q/K/V and the KV blocks rotate around the ring (``lax.ppermute``), one hop
+per step, so every Q block sees every KV block after S-1 rotations while
+per-device attention memory stays O((T/S)^2). This is the blockwise/ring
+formulation of Liu et al. 2023 ("Ring Attention with Blockwise
+Transformers") expressed in shard_map + online softmax.
+
+Causality skips work at the schedule level too: a KV block strictly in the
+future of the local Q block contributes nothing; its scores are fully
+masked and the online-softmax update degenerates to a no-op (exp(-inf)=0),
+letting XLA overlap the ppermute with the masked-block math.
+
+The ring hop rides the ICI neighbor links — ``ppermute`` with the
+(i -> i+1) permutation is exactly the collective the TPU torus is built
+for; bandwidth per step is one KV block, independent of S.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from building_llm_from_scratch_tpu.parallel.mesh import DATA_AXIS, SEQ_AXIS
+
+_NEG_INF = -1e30
+
+
+def _ring_attention_local(q, k, v, *, axis_name: str, axis_size: int,
+                          scale: float):
+    """Per-device ring attention body (runs INSIDE shard_map).
+
+    q: (B, Tl, Hq, D) local query block; k/v: (B, Tl, Hkv, D) local KV
+    block. Returns the local output block (B, Tl, Hq, D). Numerics follow
+    ops/attention.py's xla oracle: fp32 scores + online softmax, output cast
+    back to v.dtype.
+    """
+    B, Tl, Hq, D = q.shape
+    Hkv = k.shape[2]
+    G = Hq // Hkv
+    my = jax.lax.axis_index(axis_name)
+
+    qg = q.reshape(B, Tl, Hkv, G, D)
+    iq = jnp.arange(Tl)
+    ik = jnp.arange(Tl)
+    q_pos = my * Tl + iq                                   # global positions
+
+    # online-softmax accumulators, fp32
+    m = jnp.full((B, Hkv, G, Tl), _NEG_INF, jnp.float32)   # running max
+    l = jnp.zeros((B, Hkv, G, Tl), jnp.float32)            # running denom
+    o = jnp.zeros((B, Hkv, G, Tl, D), jnp.float32)         # running numer
+
+    # Python loop: axis_size is static and small; unrolling lets XLA overlap
+    # each ppermute with the previous block's math
+    for r in range(axis_size):
+        # after r forward rotations, this device holds the KV block that
+        # started on device (my - r) mod S
+        src = (my - r) % axis_size
+        kv_pos = src * Tl + ik
+        s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k,
+                       preferred_element_type=jnp.float32) * scale
+        mask = (q_pos[:, None] >= kv_pos[None, :])[None, None, None]
+        s = jnp.where(mask, s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        corr = jnp.exp(m - m_new)
+        p_blk = jnp.exp(s - m_new[..., None])
+        # a fully-masked (future) block: p_blk == 0 everywhere, so l/o pass
+        # through unchanged — the causal skip falls out of the math
+        l = l * corr + p_blk.sum(axis=-1)
+        o = o * corr[..., None] + jnp.einsum(
+            "bhgqk,bkhd->bhgqd", p_blk, v.astype(jnp.float32))
+        m = m_new
+        if r + 1 < axis_size:
+            perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+            k = jax.lax.ppermute(k, axis_name, perm)
+            v = jax.lax.ppermute(v, axis_name, perm)
+
+    out = o / jnp.maximum(l, 1e-37)[..., None]             # (B,Hkv,G,Tl,D)
+    return out.transpose(0, 3, 1, 2, 4).reshape(B, Tl, Hq, D).astype(v.dtype)
+
+
+def ring_causal_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                          mesh: Mesh,
+                          seq_axis: str = SEQ_AXIS,
+                          batch_axis: Optional[str] = DATA_AXIS
+                          ) -> jnp.ndarray:
+    """Causal GQA attention with the T axis sharded over ``mesh[seq_axis]``.
+
+    q: (B, T, Hq, D), k/v: (B, T, Hkv, D) — GLOBAL shapes; inside the
+    shard_map each device sees its (B/dp, T/S, H, D) block. Call from code
+    already running under jit with GSPMD shardings (transformer.forward);
+    the shard_map boundary forces the (batch, seq) layout and hands the ring
+    schedule ownership of the communication.
+
+    No attention-dropout support (same restriction as the pallas kernel);
+    the transformer enforces this before calling.
+    """
+    S = mesh.shape[seq_axis]
+    if S <= 1:
+        raise ValueError("ring_causal_attention needs a seq axis > 1; "
+                         "use ops.attention.causal_attention instead")
+    if q.shape[1] % S != 0:
+        raise ValueError(
+            f"sequence length {q.shape[1]} not divisible by seq axis {S}")
+    D = q.shape[-1]
+    scale = 1.0 / float(D) ** 0.5
+
+    # compose with tensor parallelism: when the model axis is live and the
+    # head counts divide it, keep heads sharded through the ring (each model
+    # shard rings only its own heads) instead of all-gathering and
+    # recomputing every head tp times
+    from building_llm_from_scratch_tpu.parallel.mesh import MODEL_AXIS
+
+    tp = mesh.shape.get(MODEL_AXIS, 1)
+    Hq, Hkv = q.shape[2], k.shape[2]
+    head_axis = (MODEL_AXIS
+                 if tp > 1 and Hq % tp == 0 and Hkv % tp == 0 else None)
+    spec = P(batch_axis, seq_axis, head_axis, None)
+
+    body = functools.partial(_ring_attention_local, axis_name=seq_axis,
+                             axis_size=S, scale=scale)
+    return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)

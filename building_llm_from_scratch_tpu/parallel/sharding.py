@@ -132,6 +132,16 @@ class MeshPlan:
     def n_model(self) -> int:
         return self.mesh.shape[MODEL_AXIS]
 
+    @property
+    def n_seq(self) -> int:
+        return self.mesh.shape[SEQ_AXIS]
+
+    @property
+    def sp_mesh(self):
+        """The mesh to hand ``forward``'s ring-attention path, or None when
+        sequence parallelism is off."""
+        return self.mesh if self.n_seq > 1 else None
+
     # -- spec rules ----------------------------------------------------
 
     def _is_stacked(self, names: Tuple[str, ...]) -> bool:
@@ -179,6 +189,10 @@ class MeshPlan:
         return self.param_spec(names, shape)
 
     def batch_spec(self) -> P:
+        if self.n_seq > 1:
+            # sequence parallelism: tokens shard over (data, seq); the
+            # token-local compute follows via GSPMD, attention via the ring
+            return P(DATA_AXIS, SEQ_AXIS)
         return P(DATA_AXIS)
 
     # -- pytree placement ---------------------------------------------
@@ -266,8 +280,10 @@ class MeshPlan:
         reference's DistributedSampler index sharding.
         """
         def put(x):
-            sharding = self._named(
-                P(*([DATA_AXIS] + [None] * (np.ndim(x) - 1))))
+            axes = [DATA_AXIS] + [None] * (np.ndim(x) - 1)
+            if self.n_seq > 1 and np.ndim(x) >= 2:
+                axes[1] = SEQ_AXIS           # (B, T, ...) -> shard T too
+            sharding = self._named(P(*axes))
             if jax.process_count() == 1:
                 return jax.device_put(x, sharding)
             return jax.make_array_from_process_local_data(sharding, x)

@@ -129,7 +129,9 @@ def load_checkpoint(ckpt_dir: str, template_state: Params,
                     "Checkpoint rng leaf has shape %s but the current PRNG "
                     "impl uses %s; keeping a fresh rng (dropout stream "
                     "restarts).", tuple(meta["shape"]), tmpl_shape)
-                loaded.append(tmpl)
+                # same placement contract as every other restored leaf
+                loaded.append(jax.device_put(tmpl, shard)
+                              if shard is not None else tmpl)
                 continue
             raise ValueError(
                 f"Checkpoint leaf '{meta['path']}' has shape "

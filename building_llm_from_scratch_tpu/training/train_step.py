@@ -97,10 +97,13 @@ def make_train_step(cfg: ModelConfig, optimizer: optax.GradientTransformation,
                     lora_alpha: Optional[float] = None,
                     lora_rank: Optional[int] = None,
                     policy: Optional[PrecisionPolicy] = None,
+                    sp_mesh=None,
                     jit: bool = True) -> Callable:
     """Build train_step(state, batch) -> (state, metrics).
 
     batch: {"inputs": (B,T) i32, "targets": (B,T) i32, "weights": (B,T) f32}.
+    ``sp_mesh``: mesh with seq axis > 1 routes attention through the ring
+    schedule (sequence parallelism; see ops/ring_attention.py).
     """
     full_params = make_full_params_fn(cfg, lora_alpha=lora_alpha,
                                       lora_rank=lora_rank, policy=policy)
@@ -112,7 +115,8 @@ def make_train_step(cfg: ModelConfig, optimizer: optax.GradientTransformation,
         def loss_fn(trainable):
             params = full_params(trainable, state["frozen"])
             logits = forward(params, cfg, batch["inputs"], rng=step_rng,
-                             deterministic=(cfg.drop_rate <= 0.0))
+                             deterministic=(cfg.drop_rate <= 0.0),
+                             sp_mesh=sp_mesh)
             return cross_entropy_loss(logits, batch["targets"],
                                       batch.get("weights"))
 
@@ -203,6 +207,7 @@ def make_sharded_train_step(cfg: ModelConfig,
                             lora_alpha: Optional[float] = None,
                             lora_rank: Optional[int] = None,
                             policy: Optional[PrecisionPolicy] = None,
+                            sp_mesh=None,
                             jit: bool = True) -> Callable:
     """Explicit-collective train step via ``jax.shard_map``.
 
@@ -223,6 +228,11 @@ def make_sharded_train_step(cfg: ModelConfig,
 
     from building_llm_from_scratch_tpu.parallel.mesh import DATA_AXIS
 
+    if sp_mesh is not None:
+        # the dp shard_map already owns the whole step's communication; a
+        # nested ring schedule is not supported on this path
+        raise ValueError("sequence parallelism is not supported with the "
+                         "explicit-psum (bf16_hybrid dp) step")
     full_params = make_full_params_fn(cfg, lora_alpha=lora_alpha,
                                       lora_rank=lora_rank, policy=policy)
     reduce_dtype = (policy.jax_reduce_dtype if policy is not None
@@ -278,6 +288,7 @@ def make_eval_step(cfg: ModelConfig, *,
                    lora_alpha: Optional[float] = None,
                    lora_rank: Optional[int] = None,
                    policy: Optional[PrecisionPolicy] = None,
+                   sp_mesh=None,
                    jit: bool = True) -> Callable:
     """Build eval_step(state, batch) -> loss (deterministic, no grads)."""
     full_params = make_full_params_fn(cfg, lora_alpha=lora_alpha,
@@ -285,7 +296,7 @@ def make_eval_step(cfg: ModelConfig, *,
 
     def eval_step(state: Params, batch: Dict[str, jnp.ndarray]) -> jnp.ndarray:
         params = full_params(state["trainable"], state["frozen"])
-        logits = forward(params, cfg, batch["inputs"])
+        logits = forward(params, cfg, batch["inputs"], sp_mesh=sp_mesh)
         return cross_entropy_loss(logits, batch["targets"],
                                   batch.get("weights"))
 

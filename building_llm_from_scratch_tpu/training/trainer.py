@@ -181,10 +181,13 @@ class Trainer:
                         self.resume_from, self.global_step, self.tokens_seen)
         self.state = state
         kw = dict(lora_alpha=self.lora_alpha, lora_rank=self.lora_rank,
-                  policy=self.policy)
+                  policy=self.policy,
+                  sp_mesh=(self.plan.sp_mesh if self.plan is not None
+                           else None))
         if (self.plan is not None and self.policy is not None
                 and self.policy.reduce_dtype != self.policy.compute_dtype
-                and self.plan.shard_mode == "dp"):
+                and self.plan.shard_mode == "dp"
+                and self.plan.sp_mesh is None):
             # the policy separates compute and reduce dtypes (bf16_hybrid):
             # only the explicit shard_map step controls the psum dtype.
             # dp ONLY: the shard_map step declares the state P() (replicated),
@@ -197,10 +200,13 @@ class Trainer:
         else:
             if (self.plan is not None and self.policy is not None
                     and self.policy.reduce_dtype != self.policy.compute_dtype):
+                why = ("sequence parallelism (--sp)"
+                       if self.plan.sp_mesh is not None
+                       else f"shard_mode {self.plan.shard_mode}")
                 logger.warning(
-                    "shard_mode %s does not support the explicit %s-reduce "
-                    "step (dp only); gradients will be reduced by GSPMD in "
-                    "the compute dtype, not %s", self.plan.shard_mode,
+                    "%s does not support the explicit %s-reduce step "
+                    "(dp without sp only); gradients will be reduced by "
+                    "GSPMD in the compute dtype, not %s", why,
                     self.policy.name, self.policy.reduce_dtype)
             self.train_step = make_train_step(
                 self.cfg, self.optimizer, lr_schedule=self.lr_schedule, **kw)

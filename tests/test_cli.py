@@ -73,6 +73,24 @@ def test_cli_multichip_fsdp(data_dir, tmp_path):
     assert np.isfinite(trainer.train_losses).all()
 
 
+def test_cli_multichip_sequence_parallel(data_dir, tmp_path):
+    """--sp 2 trains with ring attention over the seq mesh axis."""
+    out = str(tmp_path / "out_sp")
+    trainer = main(_args(data_dir, out, "--run_type", "multi_chip",
+                         "--model", "llama3_2", "--num_params", "1B",
+                         "--sp", "2"))
+    assert trainer.plan.n_seq == 2
+    x = trainer.state["trainable"]["blocks"]["attn"]["wq"]
+    assert len(x.sharding.device_set) == 8
+    assert np.isfinite(trainer.train_losses).all()
+
+
+def test_checks_sp_rejects_gpt2_dropout(data_dir):
+    with pytest.raises(ValueError, match="attention dropout"):
+        get_args(["--data_dir", data_dir, "--run_type", "multi_chip",
+                  "--sp", "2"])
+
+
 def test_cli_resume(data_dir, tmp_path):
     out = str(tmp_path / "out_r")
     first = main(_args(data_dir, out))

@@ -1,0 +1,149 @@
+"""Ring attention (sequence parallelism) parity on the 8-device CPU mesh:
+the ring schedule is placement, not semantics — outputs, gradients and
+training losses must match the single-device oracle."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from building_llm_from_scratch_tpu.configs import get_config
+from building_llm_from_scratch_tpu.models import forward, init_params
+from building_llm_from_scratch_tpu.ops.attention import causal_attention
+from building_llm_from_scratch_tpu.ops.ring_attention import (
+    ring_causal_attention,
+)
+from building_llm_from_scratch_tpu.parallel import build_mesh_plan
+from building_llm_from_scratch_tpu.training import (
+    build_optimizer,
+    init_train_state,
+    make_train_step,
+)
+
+
+def _qkv(B=2, T=256, Hq=4, Hkv=2, D=16, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (B, T, Hq, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, T, Hkv, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, T, Hkv, D), jnp.float32)
+    return q, k, v
+
+
+@pytest.mark.parametrize("sp", [2, 4])
+def test_ring_matches_xla_oracle(sp):
+    plan = build_mesh_plan("dp", sp=sp)
+    # batch must divide the data axis (8/sp devices)
+    q, k, v = _qkv(B=8 // sp)
+    want = causal_attention(q, k, v, impl="xla")
+    got = jax.jit(lambda a, b, c: ring_causal_attention(a, b, c, plan.mesh))(
+        q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_ring_gradients_match_xla():
+    plan = build_mesh_plan("dp", sp=4)
+    q, k, v = _qkv(T=128)
+
+    def loss(fn, q, k, v):
+        return jnp.sum(fn(q, k, v) ** 2)
+
+    gw = jax.grad(lambda *a: loss(
+        lambda x, y, z: causal_attention(x, y, z, impl="xla"), *a),
+        argnums=(0, 1, 2))(q, k, v)
+    gr = jax.jit(jax.grad(lambda *a: loss(
+        lambda x, y, z: ring_causal_attention(x, y, z, plan.mesh), *a),
+        argnums=(0, 1, 2)))(q, k, v)
+    for a, b in zip(gr, gw):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_ring_rejects_indivisible_seq():
+    plan = build_mesh_plan("dp", sp=4)
+    q, k, v = _qkv(T=130)
+    with pytest.raises(ValueError, match="not divisible"):
+        ring_causal_attention(q, k, v, plan.mesh)
+
+
+def _llama_cfg():
+    # fp32 params: the ring path carries softmax weights in fp32 through the
+    # PV accumulation while the xla oracle casts them to the value dtype
+    # first, so under bf16 params the two differ by ~bf16-epsilon — parity
+    # is asserted in fp32 where both are exact
+    return get_config("llama3_2", "1B", debug=True).replace(
+        emb_dim=64, hidden_dim=128, vocab_size=512, context_length=128,
+        drop_rate=0.0, dtype="fp32")
+
+
+def test_sp_forward_matches_single_device():
+    """Full-model forward with sp=4 == plain forward."""
+    cfg = _llama_cfg()
+    plan = build_mesh_plan("dp", sp=4)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    toks = np.arange(2 * cfg.context_length, dtype=np.int32).reshape(2, -1) \
+        % cfg.vocab_size
+    want = forward(params, cfg, toks)
+    sharded = plan.shard_params(params, copy=False)
+    batch_toks = plan.shard_batch({"inputs": toks})["inputs"]
+    got = jax.jit(lambda p, t: forward(p, cfg, t, sp_mesh=plan.mesh))(
+        sharded, batch_toks)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_sp_training_matches_single_device():
+    """3 sp=4 (dp=2 x seq=4) train steps == 3 single-device steps — the
+    load-bearing sequence-parallelism parity case (round-2 VERDICT #8)."""
+    cfg = _llama_cfg()
+    opt = build_optimizer(peak_lr=1e-3, warmup_steps=2, total_steps=10)
+    rng = np.random.default_rng(0)
+    batches = []
+    for s in range(3):
+        x = rng.integers(0, cfg.vocab_size,
+                         (8, cfg.context_length)).astype(np.int32)
+        batches.append({"inputs": x, "targets": np.roll(x, -1, 1),
+                        "weights": np.ones_like(x, np.float32)})
+
+    ref_state = init_train_state(init_params(cfg, jax.random.PRNGKey(0)),
+                                 opt, jax.random.PRNGKey(0))
+    ref_step = make_train_step(cfg, opt)
+    ref_losses = []
+    for b in batches:
+        ref_state, m = ref_step(ref_state, b)
+        ref_losses.append(float(m["loss"]))
+
+    plan = build_mesh_plan("dp", sp=4)
+    assert plan.mesh.shape == {"data": 2, "seq": 4, "model": 1}
+    state = init_train_state(init_params(cfg, jax.random.PRNGKey(0)),
+                             opt, jax.random.PRNGKey(0))
+    state = plan.shard_state(state)
+    step = make_train_step(cfg, opt, sp_mesh=plan.sp_mesh)
+    losses = []
+    for b in batches:
+        state, m = step(state, plan.shard_batch(b))
+        losses.append(float(m["loss"]))
+
+    np.testing.assert_allclose(losses, ref_losses, rtol=2e-4, atol=2e-5)
+    ref_w = np.asarray(ref_state["trainable"]["blocks"]["attn"]["wq"])
+    got_w = np.asarray(
+        jax.device_get(state["trainable"]["blocks"]["attn"]["wq"]))
+    np.testing.assert_allclose(got_w, ref_w, rtol=2e-3, atol=2e-5)
+
+
+def test_sp_with_fsdp_params():
+    """sp composes with fsdp param sharding (data axis shards params AND
+    batch rows; seq axis shards tokens)."""
+    cfg = _llama_cfg()
+    opt = build_optimizer(total_steps=10)
+    plan = build_mesh_plan("fsdp", sp=4)
+    state = plan.shard_state(init_train_state(
+        init_params(cfg, jax.random.PRNGKey(0)), opt, jax.random.PRNGKey(0)))
+    step = make_train_step(cfg, opt, sp_mesh=plan.sp_mesh)
+    rng = np.random.default_rng(1)
+    x = rng.integers(0, cfg.vocab_size,
+                     (8, cfg.context_length)).astype(np.int32)
+    batch = plan.shard_batch({"inputs": x, "targets": np.roll(x, -1, 1),
+                              "weights": np.ones_like(x, np.float32)})
+    state, m = step(state, batch)
+    assert np.isfinite(float(m["loss"]))
