@@ -36,7 +36,7 @@ def fetch_alpaca(file_path: str, url: str = ALPACA_URL) -> List[dict]:
         logger.info("Downloading from %s ...", url)
         with request.urlopen(url) as resp:
             text = resp.read().decode("utf-8")
-        json.loads(text)                    # validate BEFORE caching
+        data = json.loads(text)             # validate BEFORE caching
         tmp = file_path + ".tmp"
         with open(tmp, "w", encoding="utf-8") as f:
             f.write(text)
@@ -44,8 +44,8 @@ def fetch_alpaca(file_path: str, url: str = ALPACA_URL) -> List[dict]:
         logger.info("Saved to %s", file_path)
     else:
         logger.info("File already exists at %s", file_path)
-    with open(file_path, "r", encoding="utf-8") as f:
-        data = json.load(f)
+        with open(file_path, "r", encoding="utf-8") as f:
+            data = json.load(f)
     logger.info("Loaded %d records from %s", len(data), file_path)
     return data
 

@@ -162,11 +162,19 @@ def download_archive(url: str, output_path: str,
         from urllib import request
 
         logger.info("Downloading %s -> %s", url, output_path)
-        with request.urlopen(url) as resp, open(output_path, "wb") as f:
+        tmp = output_path + ".tmp"
+        with request.urlopen(url) as resp, open(tmp, "wb") as f:
             f.write(resp.read())
+        # rename-on-success: an interrupted download must not poison the
+        # cache-if-exists check (same pattern as alpaca.fetch_alpaca)
+        os.replace(tmp, output_path)
     else:
         logger.info("Archive already exists at %s", output_path)
-    if extract_dir is not None and zipfile.is_zipfile(output_path):
+    if extract_dir is not None:
+        if not zipfile.is_zipfile(output_path):
+            raise ValueError(
+                f"{output_path} is not a zip archive; cannot extract to "
+                f"{extract_dir} (delete it to re-download)")
         with zipfile.ZipFile(output_path) as zf:
             zf.extractall(extract_dir)
         return extract_dir

@@ -280,9 +280,8 @@ class MeshPlan:
         reference's DistributedSampler index sharding.
         """
         def put(x):
-            axes = [DATA_AXIS] + [None] * (np.ndim(x) - 1)
-            if self.n_seq > 1 and np.ndim(x) >= 2:
-                axes[1] = SEQ_AXIS           # (B, T, ...) -> shard T too
+            # batch_spec covers the leading (B[, T]) dims; pad/trim to rank
+            axes = (list(self.batch_spec()) + [None] * np.ndim(x))[:np.ndim(x)]
             sharding = self._named(P(*axes))
             if jax.process_count() == 1:
                 return jax.device_put(x, sharding)

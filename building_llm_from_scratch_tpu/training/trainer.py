@@ -128,14 +128,18 @@ class Trainer:
     def _setup(self, total_steps: int):
         """Build optimizer/schedule/jitted steps once total steps are known
         (the reference computes its cosine horizon the same way,
-        train.py:155). On resume the cosine horizon extends by the steps
-        already taken — the restored optax count continues from there, so a
-        horizon of only this run's steps would pin the whole run at min_lr."""
+        train.py:155). On resume the ORIGINAL schedule horizon (persisted in
+        checkpoint metadata) is reused so the decay trajectory matches an
+        uninterrupted run; it only extends when the requested steps overshoot
+        it (e.g. resuming with extra epochs)."""
         prev_steps = 0
+        prev_horizon = 0
         if self.resume_from is not None:
-            prev_steps = int(checkpoint_metadata(self.resume_from)
-                             .get("global_step", 0))
-        horizon = total_steps + prev_steps
+            meta = checkpoint_metadata(self.resume_from)
+            prev_steps = int(meta.get("global_step", 0))
+            prev_horizon = int(meta.get("schedule_horizon", 0))
+        horizon = max(prev_horizon, total_steps + prev_steps)
+        self._schedule_horizon = horizon
         self.lr_schedule = warmup_cosine_schedule(
             self.opt_hparams["peak_lr"], self.opt_hparams["initial_lr"],
             self.opt_hparams["min_lr"], self.opt_hparams["warmup_steps"],
@@ -269,6 +273,9 @@ class Trainer:
             "global_step": self.global_step,
             "tokens_seen": self.tokens_seen,
             "model": self.cfg.name,
+            # resume rebuilds the cosine schedule over THIS horizon so the
+            # decay matches an uninterrupted run (round-2 ADVICE low #5)
+            "schedule_horizon": getattr(self, "_schedule_horizon", 0),
         })
         logger.info("Saved checkpoint %s", path)
         return path
