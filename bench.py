@@ -38,6 +38,7 @@ RECORDED = {
     "cfg4": 17877.9,       # r03
     "cfg5": 16330.3,       # r03
     "trainer": 60781.6,    # r03 headline — the loop must keep up with it
+    "decode": 3437.6,     # r03 first recorded
 }
 
 # NOTE: on the axon remote backend jax.block_until_ready() returns at
@@ -223,6 +224,31 @@ def bench_trainer(n_steps=60):
     return "tokens/sec/chip GPT2-124M Trainer-loop bf16 bs4 ctx1024", tps
 
 
+def bench_decode(max_new=256):
+    """Generation throughput: jitted KV-cache greedy decode on GPT2-124M
+    (beyond reference parity — its generate.py re-runs the FULL forward per
+    token with no cache, generate.py:36-45)."""
+    import time
+
+    from building_llm_from_scratch_tpu.configs import get_config
+    from building_llm_from_scratch_tpu.generate import generate
+    from building_llm_from_scratch_tpu.models import init_params
+
+    cfg = get_config("GPT2", "124M", dtype="bf16")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    prompt = np.arange(32, dtype=np.int32)[None].repeat(8, 0)  # bs8
+    kw = dict(max_new_tokens=max_new, context_size=cfg.context_length)
+    out = generate(params, cfg, prompt, **kw)       # compile + warm
+    _ = np.asarray(out)
+    t0 = time.perf_counter()
+    out = generate(params, cfg, prompt, **kw)
+    _ = np.asarray(out)
+    dt = time.perf_counter() - t0
+    n_tok = (out.shape[1] - prompt.shape[1]) * prompt.shape[0]
+    return ("decode tokens/sec GPT2-124M bf16 bs8 kv-cache greedy",
+            n_tok / dt)
+
+
 BENCHES = {
     "headline": bench_headline,
     "cfg1": bench_cfg1,
@@ -231,6 +257,7 @@ BENCHES = {
     "cfg4": bench_cfg4,
     "cfg5": bench_cfg5,
     "trainer": bench_trainer,
+    "decode": bench_decode,
 }
 
 

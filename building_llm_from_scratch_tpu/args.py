@@ -76,6 +76,29 @@ def perform_checks(args) -> None:
         raise ValueError(
             f"--shard_mode {args.shard_mode} requires --tp >= 2.")
 
+    if args.shard_mode == "pp":
+        if args.model == "GPT2":
+            raise ValueError(
+                "--shard_mode pp is not supported for GPT2 (attention "
+                "dropout); use a LLaMA-family model.")
+        if args.use_lora:
+            raise ValueError("--shard_mode pp does not support LoRA yet.")
+        if args.mixed_precision is not None:
+            raise ValueError(
+                "--shard_mode pp does not take a --mixed_precision policy "
+                "yet; use --data_type bf16 for bf16 params/compute.")
+        if args.data_type == "fp16":
+            raise ValueError(
+                "--shard_mode pp does not support fp16 (the pipelined loss "
+                "has no loss-scaling state yet); use bf16.")
+        if args.tp > 1 or args.sp > 1:
+            raise ValueError("--shard_mode pp composes with neither --tp "
+                             "nor --sp yet.")
+        if args.batch_size % args.pp_micro != 0:
+            raise ValueError(
+                f"--batch_size {args.batch_size} must be divisible by "
+                f"--pp_micro {args.pp_micro}.")
+
     if args.sp > 1:
         if args.run_type != "multi_chip":
             raise ValueError("--sp > 1 requires --run_type multi_chip.")
@@ -177,9 +200,15 @@ def get_args(argv=None):
                         choices=["single_chip", "multi_chip"],
                         help="Run on one chip or shard over the mesh.")
     parser.add_argument("--shard_mode", type=str, default="dp",
-                        choices=list(SHARD_MODES),
+                        choices=list(SHARD_MODES) + ["pp"],
                         help="Parallelism strategy over the device mesh "
-                             "(replaces --use_fsdp/--use_zero_opt).")
+                             "(replaces --use_fsdp/--use_zero_opt); 'pp' = "
+                             "GPipe-style pipeline over all devices.")
+    parser.add_argument("--pp", type=int, default=0,
+                        help="Pipeline stage count for --shard_mode pp "
+                             "(0 = one stage per device).")
+    parser.add_argument("--pp_micro", type=int, default=8,
+                        help="Microbatches per step for --shard_mode pp.")
     parser.add_argument("--tp", type=int, default=1,
                         help="Tensor-parallel degree (model mesh axis).")
     parser.add_argument("--sp", type=int, default=1,

@@ -81,6 +81,14 @@ def build_plan(args) -> Optional[MeshPlan]:
     """Flags -> MeshPlan (replaces multigpu_setup, build_components.py:142-182)."""
     if args.run_type != "multi_chip":
         return None
+    if args.shard_mode == "pp":
+        from building_llm_from_scratch_tpu.parallel.pipeline import (
+            PipelinePlan,
+            make_pp_mesh,
+        )
+
+        stages = args.pp or len(jax.devices())
+        return PipelinePlan(make_pp_mesh(stages), n_micro=args.pp_micro)
     return build_mesh_plan(args.shard_mode, tp=args.tp, sp=args.sp)
 
 

@@ -16,6 +16,12 @@ from building_llm_from_scratch_tpu.parallel.sharding import (
     MeshPlan,
     build_mesh_plan,
 )
+from building_llm_from_scratch_tpu.parallel.pipeline import (
+    PipelinePlan,
+    make_pp_loss_fn,
+    make_pp_mesh,
+    make_pp_train_step,
+)
 from building_llm_from_scratch_tpu.parallel.collectives import (
     all_gather,
     gather_full,
@@ -26,6 +32,10 @@ from building_llm_from_scratch_tpu.parallel.collectives import (
 )
 
 __all__ = [
+    "PipelinePlan",
+    "make_pp_loss_fn",
+    "make_pp_mesh",
+    "make_pp_train_step",
     "DATA_AXIS",
     "MODEL_AXIS",
     "SEQ_AXIS",

@@ -1,0 +1,276 @@
+"""Pipeline parallelism: GPipe-style microbatch pipelining over a ``stage``
+mesh axis.
+
+Beyond reference parity (the reference has no pipeline story — SURVEY §2.2
+lists PP as absent): the L stacked transformer blocks are split into S
+contiguous stages, each stage owning L/S layers; a training batch is split
+into M microbatches that flow through the stages with ``lax.ppermute``
+moving activations one hop per tick. After ``M + S - 1`` ticks every
+microbatch has crossed every stage; the last stage accumulates the
+token-weighted loss.
+
+The TPU-first trick: the WHOLE schedule is a differentiable ``lax.scan``
+inside one ``shard_map`` — ``jax.grad`` transposes it into the reverse
+pipeline automatically (the transpose of a ring ppermute is the reverse
+ppermute), so forward and backward share one implementation and the
+optimizer step stays the ordinary optax update. XLA overlaps each tick's
+hop (ICI neighbor transfer) with the next tick's layer compute.
+
+Scope: deterministic forward only (dropout-free models — same restriction
+as ring attention); embeddings/norm/head are replicated and evaluated where
+needed (stage 0 embeds, the last stage projects). Bubble fraction is
+(S-1)/(M+S-1) — choose M >= S for efficiency.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from building_llm_from_scratch_tpu.configs import ModelConfig
+from building_llm_from_scratch_tpu.models.transformer import (
+    _block,
+    _embed,
+    _norm,
+    _rope_tables,
+)
+
+Params = Dict[str, Any]
+
+STAGE_AXIS = "stage"
+DATA_AXIS = "data"
+
+
+def make_pp_mesh(n_stages: int, devices=None) -> Mesh:
+    """A (data=1, stage=S) mesh over the first ``n_stages`` devices. Data
+    parallelism inside a pp run is not wired yet, so devices beyond the
+    stage count sit idle — warned, since that is a real throughput loss."""
+    from building_llm_from_scratch_tpu.utils.logging import setup_logger
+
+    devices = list(devices if devices is not None else jax.devices())
+    if jax.process_count() > 1:
+        raise NotImplementedError(
+            "pipeline parallelism is single-process for now (its batch "
+            "placement replicates; multi-host feeds are not wired)")
+    if n_stages > len(devices):
+        raise ValueError(
+            f"{n_stages} stages > {len(devices)} available devices")
+    if n_stages < len(devices):
+        setup_logger(__name__).warning(
+            "pp uses %d of %d devices (no data axis yet); %d devices idle",
+            n_stages, len(devices), len(devices) - n_stages)
+    arr = np.asarray(devices[: n_stages]).reshape(1, n_stages)
+    return Mesh(arr, (DATA_AXIS, STAGE_AXIS))
+
+
+def _stack_blocks(blocks: Params, n_stages: int) -> Params:
+    """(L, ...) stacked block params -> (S, L/S, ...) stage-major."""
+    def reshape(x):
+        L = x.shape[0]
+        return x.reshape(n_stages, L // n_stages, *x.shape[1:])
+
+    return jax.tree_util.tree_map(reshape, blocks)
+
+
+def stage_shardings(params: Params, mesh: Mesh) -> Params:
+    """Shardings for pp: block params stage-sharded, the rest replicated."""
+    def spec_of(path, leaf):
+        names = [getattr(p, "key", None) for p in path]
+        if "blocks" in names and np.ndim(leaf) >= 1:
+            return NamedSharding(mesh, P(STAGE_AXIS))
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map_with_path(spec_of, params)
+
+
+def make_pp_loss_fn(cfg: ModelConfig, mesh: Mesh, n_micro: int
+                    ) -> Callable:
+    """Build loss_fn(params, batch) -> mean CE, pipelined over the mesh's
+    stage axis. ``params`` uses the normal (L, ...) layout; the stage split
+    happens inside. Differentiable — wrap in jax.value_and_grad."""
+    S = mesh.shape[STAGE_AXIS]
+    if cfg.n_layers % S != 0:
+        raise ValueError(
+            f"n_layers {cfg.n_layers} not divisible by {S} stages")
+    if cfg.drop_rate > 0.0:
+        raise ValueError("pipeline parallelism requires drop_rate=0 "
+                         "(deterministic forward)")
+    rope = _rope_tables(cfg)
+
+    def local_stage(blocks_local, x):
+        """Run this stage's L/S layers (scan over the local slice)."""
+        def body(carry, p):
+            y, _ = _block(cfg, p, carry, rope, None, None, None, None, True)
+            return y, None
+
+        body = jax.checkpoint(body, prevent_cse=False)
+        x, _ = jax.lax.scan(body, x, blocks_local)
+        return x
+
+    def pp_body(params, stage_blocks, inputs_mb, targets_mb, weights_mb):
+        """Runs INSIDE shard_map. stage_blocks: this stage's (L/S, ...)
+        slice (shard_map strips the leading stage axis to size 1; squeezed
+        below). inputs/targets/weights: (M, Bm, T), replicated."""
+        s = jax.lax.axis_index(STAGE_AXIS)
+        blocks_local = jax.tree_util.tree_map(lambda x: x[0], stage_blocks)
+        M = inputs_mb.shape[0]
+        Bm, T = inputs_mb.shape[1], inputs_mb.shape[2]
+        D = cfg.emb_dim
+
+        def tick(carry, t):
+            act, nll_sum, w_sum = carry
+            # stage 0 injects microbatch t (zeros once the feed runs dry);
+            # later stages consume the activation ppermuted in last tick
+            feed_idx = jnp.clip(t, 0, M - 1)
+            embedded = _embed(cfg, params, inputs_mb[feed_idx], None, None,
+                              True)
+            act = jnp.where(s == 0, embedded, act)
+            act = local_stage(blocks_local, act)
+
+            # last stage: microbatch (t - (S-1)) completes on tick t. The
+            # V-sized head projection is the most expensive matmul in the
+            # model — lax.cond keeps it off non-final stages and warmup
+            # ticks (device-local control flow; no collectives inside, so
+            # the SPMD program stays uniform)
+            mb = jnp.clip(t - (S - 1), 0, M - 1)
+
+            def loss_terms(act):
+                x = _norm(cfg, params["final_norm"], act)
+                logits = jnp.einsum("btd,dv->btv", x,
+                                    params["head"]["weight"],
+                                    preferred_element_type=jnp.float32)
+                logp = jax.nn.log_softmax(logits, axis=-1)
+                tgt = targets_mb[mb]
+                ll = jnp.take_along_axis(
+                    logp, tgt[..., None].astype(jnp.int32), axis=-1)[..., 0]
+                w = weights_mb[mb].astype(jnp.float32)
+                return -(ll * w).sum(), w.sum()
+
+            nll_inc, w_inc = jax.lax.cond(
+                (s == S - 1) & (t >= S - 1), loss_terms,
+                lambda _: (jnp.zeros((), jnp.float32),
+                           jnp.zeros((), jnp.float32)), act)
+            nll_sum = nll_sum + nll_inc
+            w_sum = w_sum + w_inc
+
+            # hop: every stage sends its activation to the next; the wrap
+            # from the last stage back to 0 is overwritten by the feed above
+            perm = [(i, (i + 1) % S) for i in range(S)]
+            act = jax.lax.ppermute(act, STAGE_AXIS, perm)
+            return (act, nll_sum, w_sum), None
+
+        act0 = jnp.zeros((Bm, T, D), cfg.jax_dtype)
+        (_, nll_sum, w_sum), _ = jax.lax.scan(
+            tick, (act0, jnp.zeros((), jnp.float32),
+                   jnp.zeros((), jnp.float32)),
+            jnp.arange(M + S - 1))
+        # only the last stage holds the totals; share them so every stage
+        # returns the same loss (keeps grads symmetric under psum)
+        nll_sum = jax.lax.psum(nll_sum, STAGE_AXIS)
+        w_sum = jax.lax.psum(w_sum, STAGE_AXIS)
+        return nll_sum / jnp.maximum(w_sum, 1.0)
+
+    def loss_fn(params: Params, batch: Dict[str, jnp.ndarray]) -> jnp.ndarray:
+        B, T = batch["inputs"].shape
+        if B % n_micro != 0:
+            raise ValueError(
+                f"batch size {B} not divisible by n_micro {n_micro}")
+        Bm = B // n_micro
+        mb = lambda x: x.reshape(n_micro, Bm, *x.shape[1:])
+        inputs = mb(batch["inputs"])
+        targets = mb(batch["targets"])
+        weights = mb(batch.get("weights",
+                               jnp.ones_like(batch["targets"], jnp.float32)))
+
+        stage_blocks = _stack_blocks(params["blocks"], S)
+        other = {k: v for k, v in params.items() if k != "blocks"}
+
+        rep = P()
+        fn = jax.shard_map(
+            functools.partial(pp_body),
+            mesh=mesh,
+            in_specs=(rep, P(STAGE_AXIS), rep, rep, rep),
+            out_specs=rep,
+            check_vma=False,
+        )
+        # mean over stages of identical values == the value
+        return fn(other, stage_blocks, inputs, targets, weights)
+
+    return loss_fn
+
+
+class PipelinePlan:
+    """Duck-types the ``MeshPlan`` surface the Trainer/factory consume, for
+    ``--shard_mode pp``: block params (and their adam moments) shard their
+    layer axis over the stage mesh; everything else replicates; batches
+    replicate (the stage axis owns the devices)."""
+
+    shard_mode = "pp"
+    sp_mesh = None
+
+    def __init__(self, mesh: Mesh, n_micro: int = 8):
+        self.mesh = mesh
+        self.n_micro = n_micro
+        self.n_stages = mesh.shape[STAGE_AXIS]
+
+    def _named(self, spec: P) -> NamedSharding:
+        return NamedSharding(self.mesh, spec)
+
+    def param_spec(self, names, shape) -> P:
+        """Spec for one model-param leaf (the weight-conversion path places
+        each converted tensor straight onto its sharding): block leaves
+        stage-shard their layer axis, everything else replicates."""
+        if "blocks" in names and len(shape) >= 1 \
+                and shape[0] % self.n_stages == 0:
+            return P(STAGE_AXIS)
+        return P()
+
+    def state_shardings(self, state: Params) -> Params:
+        return stage_shardings(state, self.mesh)
+
+    def shard_state(self, state: Params) -> Params:
+        """Donation-safe placement (same contract as MeshPlan.shard_state)."""
+        from building_llm_from_scratch_tpu.parallel.sharding import (
+            place_state_donation_safe,
+        )
+
+        return place_state_donation_safe(state, self.state_shardings(state))
+
+    def shard_params(self, params: Params, *, copy: bool = True) -> Params:
+        from building_llm_from_scratch_tpu.parallel.sharding import put_fresh
+
+        shardings = stage_shardings(params, self.mesh)
+        if not copy:
+            return jax.device_put(params, shardings)
+        return jax.tree_util.tree_map(put_fresh, params, shardings)
+
+    def shard_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        rep = self._named(P())
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, rep), batch)
+
+
+def make_pp_train_step(cfg: ModelConfig, optimizer, mesh: Mesh, *,
+                       n_micro: int, lr_schedule: Optional[Callable] = None,
+                       jit: bool = True) -> Callable:
+    """train_step(state, batch) -> (state, metrics) with the forward+backward
+    pipelined over the stage axis. State layout matches train_step.py."""
+    import optax
+
+    from building_llm_from_scratch_tpu.training.train_step import _finish_step
+
+    loss_fn = make_pp_loss_fn(cfg, mesh, n_micro)
+
+    def train_step(state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(state["trainable"], batch)
+        return _finish_step(state, loss, grads, batch["inputs"].size,
+                            optimizer, lr_schedule, None)
+
+    if jit:
+        return jax.jit(train_step, donate_argnums=(0,))
+    return train_step

@@ -202,21 +202,7 @@ class MeshPlan:
 
     @staticmethod
     def _put_fresh(x, sharding: NamedSharding):
-        """device_put that never aliases the caller's buffers.
-
-        ``jax.device_put`` reuses ``x``'s existing device buffer whenever it
-        can serve as (part of) the target sharding — even under
-        ``may_alias=False`` (measured on jax 0.9 CPU: replicating a
-        single-device array keeps the source buffer as the device-0
-        replica). A donated train step consuming such a view deletes buffers
-        the caller still holds — e.g. two train states built from one params
-        pytree, or ``Trainer._params`` after the first step (round-2 VERDICT
-        weak #1). ``x.copy()`` severs the aliasing; host arrays always
-        transfer fresh.
-        """
-        if isinstance(x, jax.Array):
-            return jax.device_put(x.copy(), sharding)
-        return jax.device_put(x, sharding)
+        return put_fresh(x, sharding)
 
     def state_shardings(self, state: Params) -> Params:
         """Shardings for a full train state {trainable, frozen, opt_state,
@@ -235,21 +221,9 @@ class MeshPlan:
         return jax.tree_util.tree_map_with_path(spec_of, state)
 
     def shard_state(self, state: Params) -> Params:
-        """Place a train state on the mesh, donation-safe.
-
-        Only ``trainable``/``frozen``/``rng`` can alias buffers the caller
-        still holds (``init_train_state`` stores them by reference);
-        ``opt_state``/``step``/scaler leaves are freshly created there, so
-        they take the plain (possibly aliasing) ``device_put`` — no wasted
-        copy of the adam moments at 8B scale.
-        """
-        shardings = self.state_shardings(state)
-        out = {}
-        for key, sub in state.items():
-            put = (self._put_fresh if key in ("trainable", "frozen", "rng")
-                   else jax.device_put)
-            out[key] = jax.tree_util.tree_map(put, sub, shardings[key])
-        return out
+        """Place a train state on the mesh, donation-safe
+        (see ``place_state_donation_safe``)."""
+        return place_state_donation_safe(state, self.state_shardings(state))
 
     def params_shardings(self, params: Params) -> Params:
         def spec_of(path, leaf):
@@ -288,6 +262,41 @@ class MeshPlan:
             return jax.make_array_from_process_local_data(sharding, x)
 
         return jax.tree_util.tree_map(put, batch)
+
+
+def put_fresh(x, sharding: NamedSharding):
+    """device_put that never aliases the caller's buffers.
+
+    ``jax.device_put`` reuses ``x``'s existing device buffer whenever it
+    can serve as (part of) the target sharding — even under
+    ``may_alias=False`` (measured on jax 0.9 CPU: replicating a
+    single-device array keeps the source buffer as the device-0 replica).
+    A donated train step consuming such a view deletes buffers the caller
+    still holds — e.g. two train states built from one params pytree, or
+    ``Trainer._params`` after the first step (round-2 VERDICT weak #1).
+    ``x.copy()`` severs the aliasing; host arrays always transfer fresh.
+    """
+    if isinstance(x, jax.Array):
+        return jax.device_put(x.copy(), sharding)
+    return jax.device_put(x, sharding)
+
+
+def place_state_donation_safe(state: Params, shardings: Params) -> Params:
+    """Place a train state onto ``shardings``, donation-safe — shared by
+    MeshPlan and PipelinePlan.
+
+    Only ``trainable``/``frozen``/``rng`` can alias buffers the caller
+    still holds (``init_train_state`` stores them by reference);
+    ``opt_state``/``step``/scaler leaves are freshly created there, so they
+    take the plain (possibly aliasing) ``device_put`` — no wasted copy of
+    the adam moments at 8B scale.
+    """
+    out = {}
+    for key, sub in state.items():
+        put = (put_fresh if key in ("trainable", "frozen", "rng")
+               else jax.device_put)
+        out[key] = jax.tree_util.tree_map(put, sub, shardings[key])
+    return out
 
 
 def build_mesh_plan(shard_mode: str = "dp", *, tp: int = 1, sp: int = 1,

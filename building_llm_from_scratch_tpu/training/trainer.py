@@ -188,6 +188,24 @@ class Trainer:
                   policy=self.policy,
                   sp_mesh=(self.plan.sp_mesh if self.plan is not None
                            else None))
+        if self.plan is not None and self.plan.shard_mode == "pp":
+            from building_llm_from_scratch_tpu.parallel.pipeline import (
+                make_pp_loss_fn,
+                make_pp_train_step,
+            )
+
+            if self.use_lora:
+                raise ValueError(
+                    "--shard_mode pp does not support LoRA yet "
+                    "(the pipelined loss takes full-model params)")
+            self.train_step = make_pp_train_step(
+                self.cfg, self.optimizer, self.plan.mesh,
+                n_micro=self.plan.n_micro, lr_schedule=self.lr_schedule)
+            pp_loss = make_pp_loss_fn(self.cfg, self.plan.mesh,
+                                      self.plan.n_micro)
+            self.eval_step = jax.jit(
+                lambda state, batch: pp_loss(state["trainable"], batch))
+            return
         if (self.plan is not None and self.policy is not None
                 and self.policy.reduce_dtype != self.policy.compute_dtype
                 and self.plan.shard_mode == "dp"

@@ -56,7 +56,16 @@ def log_device_memory(logger, prefix: str = "") -> None:
     for d in jax.local_devices():
         stats = device_memory_stats(d)
         if not stats:
-            logger.info("%s%s: memory stats unavailable", prefix, d)
+            # remote/tunnel backends expose no live stats; fall back to the
+            # size of this process's live arrays on the device — an in-use
+            # floor, not a peak
+            live = sum(
+                x.nbytes / len(x.sharding.device_set)   # this device's share
+                for x in jax.live_arrays()
+                if getattr(x, "sharding", None) is not None
+                and d in x.sharding.device_set) / 1024**3
+            logger.info("%s%s: live stats unavailable; live jax.Arrays "
+                        "hold >= %.2fGB", prefix, d, live)
             continue
         in_use = stats.get("bytes_in_use", 0) / 1024**3
         peak = stats.get("peak_bytes_in_use", 0) / 1024**3

@@ -1,0 +1,62 @@
+"""Worker for the sp/pp CLI e2e tests (spawned by test_cli.py — not
+collected by pytest).
+
+These two e2e runs exercise shard_map collectives (ring ppermute / pipeline
+schedule) on the virtual CPU mesh; the CPU collective runtime has been
+observed to abort the interpreter under thread contention (rare,
+non-deterministic). Running them in a child process keeps an abort out of
+the suite process and lets the parent retry once.
+"""
+
+import json
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    .replace("--xla_force_host_platform_device_count=8", "").strip()
+    + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    mode, data_dir, out = sys.argv[1], sys.argv[2], sys.argv[3]
+    from building_llm_from_scratch_tpu.args import get_args
+    from building_llm_from_scratch_tpu.main import main as run_main
+
+    base = [
+        "--data_dir", data_dir, "--output_dir", out,
+        "--debug", "--byte_tokenizer", "--n_epochs", "1",
+        "--batch_size", "8", "--eval_freq", "20",
+        "--print_sample_iter", "10000", "--save_ckpt_freq", "10000",
+        "--warmup_steps", "2", "--run_type", "multi_chip",
+        "--model", "llama3_2", "--num_params", "1B",
+    ]
+    if mode == "sp":
+        args = get_args(base + ["--sp", "2"])
+        trainer = run_main(args)
+        assert trainer.plan.n_seq == 2
+        wq = trainer.state["trainable"]["blocks"]["attn"]["wq"]
+        assert len(wq.sharding.device_set) == 8
+    elif mode == "pp":
+        args = get_args(base + ["--shard_mode", "pp", "--pp", "2",
+                                "--pp_micro", "4"])
+        trainer = run_main(args)
+        assert trainer.plan.shard_mode == "pp"
+        assert trainer.plan.n_stages == 2
+        wq = trainer.state["trainable"]["blocks"]["attn"]["wq"]
+        assert len(wq.sharding.device_set) == 2
+    else:
+        raise SystemExit(f"unknown mode {mode}")
+    assert trainer.global_step > 0
+    assert np.isfinite(trainer.train_losses).all()
+    print(f"WORKER_{mode.upper()}_OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()
