@@ -76,7 +76,14 @@ def perform_checks(args) -> None:
         raise ValueError(
             f"--shard_mode {args.shard_mode} requires --tp >= 2.")
 
+    if args.shard_mode != "pp" and (args.pp > 0 or args.pp_micro != 8):
+        raise ValueError(
+            "--pp/--pp_micro only take effect with --shard_mode pp.")
     if args.shard_mode == "pp":
+        if args.pp_micro < 1:
+            raise ValueError("--pp_micro must be >= 1.")
+        if args.pp < 0:
+            raise ValueError("--pp must be >= 0 (0 = one stage/device).")
         if args.model == "GPT2":
             raise ValueError(
                 "--shard_mode pp is not supported for GPT2 (attention "
@@ -206,7 +213,8 @@ def get_args(argv=None):
                              "GPipe-style pipeline over all devices.")
     parser.add_argument("--pp", type=int, default=0,
                         help="Pipeline stage count for --shard_mode pp "
-                             "(0 = one stage per device).")
+                             "(0 = one stage per device; with fewer stages "
+                             "the data axis absorbs the rest).")
     parser.add_argument("--pp_micro", type=int, default=8,
                         help="Microbatches per step for --shard_mode pp.")
     parser.add_argument("--tp", type=int, default=1,

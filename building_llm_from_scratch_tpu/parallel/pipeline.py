@@ -19,12 +19,14 @@ hop (ICI neighbor transfer) with the next tick's layer compute.
 Scope: deterministic forward only (dropout-free models — same restriction
 as ring attention); embeddings/norm/head are replicated and evaluated where
 needed (stage 0 embeds, the last stage projects). Bubble fraction is
-(S-1)/(M+S-1) — choose M >= S for efficiency.
+(S-1)/(M+S-1) — choose M >= S for efficiency. The mesh composes a data
+axis with the stage axis ((data=D, stage=S), D = n_devices/S): each data
+column pipelines its own microbatch rows and the loss/grads psum over both
+axes.
 """
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Callable, Dict, Optional
 
 import jax
@@ -47,24 +49,20 @@ DATA_AXIS = "data"
 
 
 def make_pp_mesh(n_stages: int, devices=None) -> Mesh:
-    """A (data=1, stage=S) mesh over the first ``n_stages`` devices. Data
-    parallelism inside a pp run is not wired yet, so devices beyond the
-    stage count sit idle — warned, since that is a real throughput loss."""
-    from building_llm_from_scratch_tpu.utils.logging import setup_logger
-
+    """A (data=D, stage=S) mesh: the stage axis takes ``n_stages`` devices
+    and the data axis absorbs the rest (D = n_devices / S), so every device
+    works — microbatches shard their rows over data while activations
+    pipeline over stage."""
     devices = list(devices if devices is not None else jax.devices())
     if jax.process_count() > 1:
         raise NotImplementedError(
             "pipeline parallelism is single-process for now (its batch "
             "placement replicates; multi-host feeds are not wired)")
-    if n_stages > len(devices):
+    if len(devices) % n_stages != 0:
         raise ValueError(
-            f"{n_stages} stages > {len(devices)} available devices")
-    if n_stages < len(devices):
-        setup_logger(__name__).warning(
-            "pp uses %d of %d devices (no data axis yet); %d devices idle",
-            n_stages, len(devices), len(devices) - n_stages)
-    arr = np.asarray(devices[: n_stages]).reshape(1, n_stages)
+            f"{len(devices)} devices not divisible by {n_stages} stages")
+    d = len(devices) // n_stages
+    arr = np.asarray(devices).reshape(d, n_stages)
     return Mesh(arr, (DATA_AXIS, STAGE_AXIS))
 
 
@@ -169,18 +167,25 @@ def make_pp_loss_fn(cfg: ModelConfig, mesh: Mesh, n_micro: int
             tick, (act0, jnp.zeros((), jnp.float32),
                    jnp.zeros((), jnp.float32)),
             jnp.arange(M + S - 1))
-        # only the last stage holds the totals; share them so every stage
-        # returns the same loss (keeps grads symmetric under psum)
-        nll_sum = jax.lax.psum(nll_sum, STAGE_AXIS)
-        w_sum = jax.lax.psum(w_sum, STAGE_AXIS)
+        # only the last stage (of each data column) holds its shard's
+        # totals; reduce over BOTH axes so every device returns the same
+        # global-mean loss (keeps grads symmetric under psum — replicated
+        # params get their data-axis grad psum from the shard_map transpose)
+        nll_sum = jax.lax.psum(nll_sum, (STAGE_AXIS, DATA_AXIS))
+        w_sum = jax.lax.psum(w_sum, (STAGE_AXIS, DATA_AXIS))
         return nll_sum / jnp.maximum(w_sum, 1.0)
 
     def loss_fn(params: Params, batch: Dict[str, jnp.ndarray]) -> jnp.ndarray:
         B, T = batch["inputs"].shape
+        D_data = mesh.shape[DATA_AXIS]
         if B % n_micro != 0:
             raise ValueError(
                 f"batch size {B} not divisible by n_micro {n_micro}")
         Bm = B // n_micro
+        if Bm % D_data != 0:
+            raise ValueError(
+                f"microbatch rows {Bm} not divisible by the data axis "
+                f"{D_data} (batch {B} / n_micro {n_micro})")
         mb = lambda x: x.reshape(n_micro, Bm, *x.shape[1:])
         inputs = mb(batch["inputs"])
         targets = mb(batch["targets"])
@@ -191,14 +196,14 @@ def make_pp_loss_fn(cfg: ModelConfig, mesh: Mesh, n_micro: int
         other = {k: v for k, v in params.items() if k != "blocks"}
 
         rep = P()
+        mb_spec = P(None, DATA_AXIS)   # each data column pipelines its rows
         fn = jax.shard_map(
-            functools.partial(pp_body),
+            pp_body,
             mesh=mesh,
-            in_specs=(rep, P(STAGE_AXIS), rep, rep, rep),
+            in_specs=(rep, P(STAGE_AXIS), mb_spec, mb_spec, mb_spec),
             out_specs=rep,
             check_vma=False,
         )
-        # mean over stages of identical values == the value
         return fn(other, stage_blocks, inputs, targets, weights)
 
     return loss_fn
@@ -207,8 +212,8 @@ def make_pp_loss_fn(cfg: ModelConfig, mesh: Mesh, n_micro: int
 class PipelinePlan:
     """Duck-types the ``MeshPlan`` surface the Trainer/factory consume, for
     ``--shard_mode pp``: block params (and their adam moments) shard their
-    layer axis over the stage mesh; everything else replicates; batches
-    replicate (the stage axis owns the devices)."""
+    layer axis over the stage mesh axis; everything else replicates; the
+    data axis (when > 1) splits each microbatch's rows inside the loss."""
 
     shard_mode = "pp"
     sp_mesh = None
@@ -250,6 +255,12 @@ class PipelinePlan:
         return jax.tree_util.tree_map(put_fresh, params, shardings)
 
     def shard_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        """Replicated placement. Row-sharding the (B, T) batch over the
+        data axis would NOT line up with the microbatch-major (M, Bm)
+        split the loss performs (contiguous B-chunks span multiple
+        microbatches), so GSPMD would reshard at the shard_map boundary
+        anyway; replicating the small host batch keeps the transfer simple
+        and lets the shard_map slice locally."""
         rep = self._named(P())
         return jax.tree_util.tree_map(
             lambda x: jax.device_put(x, rep), batch)

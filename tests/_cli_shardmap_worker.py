@@ -45,12 +45,12 @@ def main():
         assert len(wq.sharding.device_set) == 8
     elif mode == "pp":
         args = get_args(base + ["--shard_mode", "pp", "--pp", "2",
-                                "--pp_micro", "4"])
+                                "--pp_micro", "2"])
         trainer = run_main(args)
         assert trainer.plan.shard_mode == "pp"
         assert trainer.plan.n_stages == 2
         wq = trainer.state["trainable"]["blocks"]["attn"]["wq"]
-        assert len(wq.sharding.device_set) == 2
+        assert len(wq.sharding.device_set) == 8  # (data=4, stage=2)
     else:
         raise SystemExit(f"unknown mode {mode}")
     assert trainer.global_step > 0

@@ -84,15 +84,21 @@ def _run_shardmap_worker(mode, data_dir, tmp_path):
     worker = os.path.join(repo, "tests", "_cli_shardmap_worker.py")
     env = dict(os.environ)
     env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
-    for attempt in range(2):
+    last = "timed out"
+    for attempt in range(3):
         out_dir = str(tmp_path / f"out_{mode}{attempt}")
-        proc = subprocess.run(
-            [_sys.executable, worker, mode, data_dir, out_dir],
-            capture_output=True, text=True, timeout=600, cwd=repo, env=env)
+        try:
+            proc = subprocess.run(
+                [_sys.executable, worker, mode, data_dir, out_dir],
+                capture_output=True, text=True, timeout=900, cwd=repo,
+                env=env)
+        except subprocess.TimeoutExpired as e:   # hung worker: also retry
+            last = f"timeout: {e.stdout}\n{e.stderr}"
+            continue
         if proc.returncode == 0 and f"WORKER_{mode.upper()}_OK" in proc.stdout:
             return
-    raise AssertionError(
-        f"{mode} CLI worker failed twice:\n{proc.stdout}\n{proc.stderr}")
+        last = f"rc={proc.returncode}: {proc.stdout}\n{proc.stderr}"
+    raise AssertionError(f"{mode} CLI worker failed 3 times; last: {last}")
 
 
 def test_cli_multichip_sequence_parallel(data_dir, tmp_path):
