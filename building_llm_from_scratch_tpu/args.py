@@ -76,10 +76,13 @@ def perform_checks(args) -> None:
         raise ValueError(
             f"--shard_mode {args.shard_mode} requires --tp >= 2.")
 
-    if args.shard_mode != "pp" and (args.pp > 0 or args.pp_micro != 8):
+    if args.shard_mode != "pp" and (args.pp != 0
+                                    or args.pp_micro is not None):
         raise ValueError(
             "--pp/--pp_micro only take effect with --shard_mode pp.")
     if args.shard_mode == "pp":
+        if args.pp_micro is None:
+            args.pp_micro = 8
         if args.pp_micro < 1:
             raise ValueError("--pp_micro must be >= 1.")
         if args.pp < 0:
@@ -215,8 +218,9 @@ def get_args(argv=None):
                         help="Pipeline stage count for --shard_mode pp "
                              "(0 = one stage per device; with fewer stages "
                              "the data axis absorbs the rest).")
-    parser.add_argument("--pp_micro", type=int, default=8,
-                        help="Microbatches per step for --shard_mode pp.")
+    parser.add_argument("--pp_micro", type=int, default=None,
+                        help="Microbatches per step for --shard_mode pp "
+                             "(default 8).")
     parser.add_argument("--tp", type=int, default=1,
                         help="Tensor-parallel degree (model mesh axis).")
     parser.add_argument("--sp", type=int, default=1,

@@ -88,7 +88,17 @@ def build_plan(args) -> Optional[MeshPlan]:
         )
 
         stages = args.pp or len(jax.devices())
-        return PipelinePlan(make_pp_mesh(stages), n_micro=args.pp_micro)
+        plan = PipelinePlan(make_pp_mesh(stages), n_micro=args.pp_micro)
+        # fail at build time, not first-step trace: each microbatch's rows
+        # must split over the mesh's data axis
+        d = plan.mesh.shape["data"]
+        if (args.batch_size // args.pp_micro) % d != 0:
+            raise ValueError(
+                f"--batch_size {args.batch_size} / --pp_micro "
+                f"{args.pp_micro} = {args.batch_size // args.pp_micro} "
+                f"microbatch rows, not divisible by the mesh data axis {d} "
+                f"({len(jax.devices())} devices / {stages} stages).")
+        return plan
     return build_mesh_plan(args.shard_mode, tp=args.tp, sp=args.sp)
 
 

@@ -106,6 +106,30 @@ def test_pp_training_matches_single_device():
     np.testing.assert_allclose(got_w, ref_w, rtol=2e-3, atol=2e-5)
 
 
+def test_pp_param_spec_for_weight_loading():
+    """The weight-conversion path places each tensor via plan.param_spec:
+    block leaves stage-shard their layer axis, non-divisible or non-block
+    leaves replicate."""
+    from jax.sharding import PartitionSpec as P
+
+    from building_llm_from_scratch_tpu.parallel.pipeline import PipelinePlan
+
+    plan = PipelinePlan(make_pp_mesh(2), n_micro=2)
+    assert plan.param_spec(("blocks", "attn", "wq"), (4, 64, 64)) \
+        == P("stage")
+    assert plan.param_spec(("blocks", "norm1", "scale"), (3, 64)) == P()
+    assert plan.param_spec(("tok_emb", "weight"), (512, 64)) == P()
+
+    # end-to-end: a converted leaf placed with this spec spans the mesh
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+
+    leaf = jnp.zeros((4, 8, 8))
+    placed = jax.device_put(leaf, NamedSharding(
+        plan.mesh, plan.param_spec(("blocks", "attn", "wq"), leaf.shape)))
+    assert len(placed.sharding.device_set) == 8      # (data=4, stage=2)
+
+
 def test_pp_rejects_bad_shapes():
     cfg = _cfg(n_layers=6)
     mesh = make_pp_mesh(4)
