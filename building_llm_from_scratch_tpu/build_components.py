@@ -88,14 +88,16 @@ def build_plan(args) -> Optional[MeshPlan]:
         )
 
         stages = args.pp or len(jax.devices())
-        plan = PipelinePlan(make_pp_mesh(stages), n_micro=args.pp_micro)
+        n_micro = args.pp_micro or 8     # perform_checks resolves this too,
+        # but don't depend on its mutation for callers that skip get_args
+        plan = PipelinePlan(make_pp_mesh(stages), n_micro=n_micro)
         # fail at build time, not first-step trace: each microbatch's rows
         # must split over the mesh's data axis
         d = plan.mesh.shape["data"]
-        if (args.batch_size // args.pp_micro) % d != 0:
+        if (args.batch_size // n_micro) % d != 0:
             raise ValueError(
                 f"--batch_size {args.batch_size} / --pp_micro "
-                f"{args.pp_micro} = {args.batch_size // args.pp_micro} "
+                f"{n_micro} = {args.batch_size // n_micro} "
                 f"microbatch rows, not divisible by the mesh data axis {d} "
                 f"({len(jax.devices())} devices / {stages} stages).")
         return plan

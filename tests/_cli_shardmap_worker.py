@@ -12,10 +12,20 @@ import json
 import os
 import sys
 
+# Few virtual devices on purpose. XLA CPU's thunk executor runs
+# independent collectives concurrently and different replicas can enter
+# them in different orders; on this 1-core host that intermittently
+# deadlocks the rendezvous until its 40s timeout aborts the process
+# ("Termination timeout ... Exiting to ensure a consistent program
+# state"). With a single collective-group family (sp: data=1 x seq=2;
+# pp: data=2 x stage=2) the cross-group deadlock cannot form. The full
+# dp x sp / dp x stage compositions are covered by the in-process parity
+# tests (test_ring_attention.py / test_pipeline.py).
+_N_DEV = {"sp": 2, "pp": 4}.get(sys.argv[1] if len(sys.argv) > 1 else "", 4)
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "")
     .replace("--xla_force_host_platform_device_count=8", "").strip()
-    + " --xla_force_host_platform_device_count=8").strip()
+    + f" --xla_force_host_platform_device_count={_N_DEV}").strip()
 
 import jax  # noqa: E402
 
@@ -42,7 +52,7 @@ def main():
         trainer = run_main(args)
         assert trainer.plan.n_seq == 2
         wq = trainer.state["trainable"]["blocks"]["attn"]["wq"]
-        assert len(wq.sharding.device_set) == 8
+        assert len(wq.sharding.device_set) == 2   # (data=1, seq=2)
     elif mode == "pp":
         args = get_args(base + ["--shard_mode", "pp", "--pp", "2",
                                 "--pp_micro", "2"])
@@ -50,7 +60,7 @@ def main():
         assert trainer.plan.shard_mode == "pp"
         assert trainer.plan.n_stages == 2
         wq = trainer.state["trainable"]["blocks"]["attn"]["wq"]
-        assert len(wq.sharding.device_set) == 8  # (data=4, stage=2)
+        assert len(wq.sharding.device_set) == 4  # (data=2, stage=2)
     else:
         raise SystemExit(f"unknown mode {mode}")
     assert trainer.global_step > 0
