@@ -91,12 +91,11 @@ def perform_checks(args) -> None:
             raise ValueError(
                 "--shard_mode pp is not supported for GPT2 (attention "
                 "dropout); use a LLaMA-family model.")
-        if args.use_lora:
-            raise ValueError("--shard_mode pp does not support LoRA yet.")
-        if args.mixed_precision is not None:
+        if args.mixed_precision in ("fp16", "bf16_hybrid"):
             raise ValueError(
-                "--shard_mode pp does not take a --mixed_precision policy "
-                "yet; use --data_type bf16 for bf16 params/compute.")
+                "--shard_mode pp supports --mixed_precision bf16/fp32 only "
+                "(no loss-scaling state; the pipelined loss owns its psum "
+                "dtypes).")
         if args.data_type == "fp16":
             raise ValueError(
                 "--shard_mode pp does not support fp16 (the pipelined loss "

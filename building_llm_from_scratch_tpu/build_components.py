@@ -94,11 +94,12 @@ def build_plan(args) -> Optional[MeshPlan]:
         # fail at build time, not first-step trace: each microbatch's rows
         # must split over the mesh's data axis
         d = plan.mesh.shape["data"]
-        if (args.batch_size // n_micro) % d != 0:
+        if (args.batch_size % n_micro != 0
+                or (args.batch_size // n_micro) % d != 0):
             raise ValueError(
-                f"--batch_size {args.batch_size} / --pp_micro "
-                f"{n_micro} = {args.batch_size // n_micro} "
-                f"microbatch rows, not divisible by the mesh data axis {d} "
+                f"--batch_size {args.batch_size} must split into "
+                f"--pp_micro {n_micro} microbatches whose rows divide the "
+                f"mesh data axis {d} "
                 f"({len(jax.devices())} devices / {stages} stages).")
         return plan
     return build_mesh_plan(args.shard_mode, tp=args.tp, sp=args.sp)

@@ -18,6 +18,7 @@ from building_llm_from_scratch_tpu.parallel.sharding import (
 )
 from building_llm_from_scratch_tpu.parallel.pipeline import (
     PipelinePlan,
+    make_pp_eval_step,
     make_pp_loss_fn,
     make_pp_mesh,
     make_pp_train_step,
@@ -33,6 +34,7 @@ from building_llm_from_scratch_tpu.parallel.collectives import (
 
 __all__ = [
     "PipelinePlan",
+    "make_pp_eval_step",
     "make_pp_loss_fn",
     "make_pp_mesh",
     "make_pp_train_step",

@@ -162,7 +162,9 @@ def make_pp_loss_fn(cfg: ModelConfig, mesh: Mesh, n_micro: int
             act = jax.lax.ppermute(act, STAGE_AXIS, perm)
             return (act, nll_sum, w_sum), None
 
-        act0 = jnp.zeros((Bm, T, D), cfg.jax_dtype)
+        # dtype follows the (possibly policy-cast) params, not the config —
+        # a mismatched fp32 zeros carry would silently promote every layer
+        act0 = jnp.zeros((Bm, T, D), params["tok_emb"]["weight"].dtype)
         (_, nll_sum, w_sum), _ = jax.lax.scan(
             tick, (act0, jnp.zeros((), jnp.float32),
                    jnp.zeros((), jnp.float32)),
@@ -268,20 +270,76 @@ class PipelinePlan:
 
 def make_pp_train_step(cfg: ModelConfig, optimizer, mesh: Mesh, *,
                        n_micro: int, lr_schedule: Optional[Callable] = None,
+                       lora_alpha: Optional[float] = None,
+                       lora_rank: Optional[int] = None,
+                       policy=None,
                        jit: bool = True) -> Callable:
     """train_step(state, batch) -> (state, metrics) with the forward+backward
-    pipelined over the stage axis. State layout matches train_step.py."""
-    import optax
+    pipelined over the stage axis. State layout matches train_step.py.
 
-    from building_llm_from_scratch_tpu.training.train_step import _finish_step
+    LoRA and compute-dtype policies ride the same ``make_full_params_fn``
+    combinator as the plain step: adapters merge into full params before the
+    stage split, so grads flow back to the adapters only. fp16 (loss
+    scaling) and bf16_hybrid (reduce-dtype control) are rejected upstream in
+    args.py — the pipelined loss owns its own psums.
+    """
+    from building_llm_from_scratch_tpu.training.train_step import (
+        _finish_step,
+        make_full_params_fn,
+    )
 
+    _check_pp_policy(policy)
+    full_params = make_full_params_fn(cfg, lora_alpha=lora_alpha,
+                                      lora_rank=lora_rank, policy=policy)
     loss_fn = make_pp_loss_fn(cfg, mesh, n_micro)
 
     def train_step(state, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(state["trainable"], batch)
+        def loss_of(trainable):
+            return loss_fn(full_params(trainable, state["frozen"]), batch)
+
+        loss, grads = jax.value_and_grad(loss_of)(state["trainable"])
         return _finish_step(state, loss, grads, batch["inputs"].size,
                             optimizer, lr_schedule, None)
 
     if jit:
         return jax.jit(train_step, donate_argnums=(0,))
     return train_step
+
+
+def _check_pp_policy(policy) -> None:
+    """The pipelined loss has no loss-scaling state and owns its own psum
+    dtypes, so fp16 (needs the scaler) and bf16_hybrid (reduce-dtype
+    control) cannot ride it — guard here, at the layer that owns the
+    constraint, not only in the CLI checks."""
+    if policy is None:
+        return
+    if policy.compute_dtype == "fp16" \
+            or policy.reduce_dtype != policy.compute_dtype:
+        raise ValueError(
+            f"pipeline parallelism supports bf16/fp32 policies only; "
+            f"got '{policy.name}'")
+
+
+def make_pp_eval_step(cfg: ModelConfig, mesh: Mesh, *, n_micro: int,
+                      lora_alpha: Optional[float] = None,
+                      lora_rank: Optional[int] = None,
+                      policy=None, jit: bool = True) -> Callable:
+    """eval_step(state, batch) -> loss on the pipelined forward — same
+    adapter/policy composition as make_pp_train_step, defined once here so
+    train and eval cannot diverge."""
+    from building_llm_from_scratch_tpu.training.train_step import (
+        make_full_params_fn,
+    )
+
+    _check_pp_policy(policy)
+    full_params = make_full_params_fn(cfg, lora_alpha=lora_alpha,
+                                      lora_rank=lora_rank, policy=policy)
+    loss_fn = make_pp_loss_fn(cfg, mesh, n_micro)
+
+    def eval_step(state, batch):
+        return loss_fn(full_params(state["trainable"], state["frozen"]),
+                       batch)
+
+    if jit:
+        return jax.jit(eval_step)
+    return eval_step

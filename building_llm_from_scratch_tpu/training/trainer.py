@@ -190,21 +190,18 @@ class Trainer:
                            else None))
         if self.plan is not None and self.plan.shard_mode == "pp":
             from building_llm_from_scratch_tpu.parallel.pipeline import (
-                make_pp_loss_fn,
+                make_pp_eval_step,
                 make_pp_train_step,
             )
 
-            if self.use_lora:
-                raise ValueError(
-                    "--shard_mode pp does not support LoRA yet "
-                    "(the pipelined loss takes full-model params)")
+            pp_kw = dict(n_micro=self.plan.n_micro,
+                         lora_alpha=self.lora_alpha,
+                         lora_rank=self.lora_rank, policy=self.policy)
             self.train_step = make_pp_train_step(
                 self.cfg, self.optimizer, self.plan.mesh,
-                n_micro=self.plan.n_micro, lr_schedule=self.lr_schedule)
-            pp_loss = make_pp_loss_fn(self.cfg, self.plan.mesh,
-                                      self.plan.n_micro)
-            self.eval_step = jax.jit(
-                lambda state, batch: pp_loss(state["trainable"], batch))
+                lr_schedule=self.lr_schedule, **pp_kw)
+            self.eval_step = make_pp_eval_step(self.cfg, self.plan.mesh,
+                                               **pp_kw)
             return
         if (self.plan is not None and self.policy is not None
                 and self.policy.reduce_dtype != self.policy.compute_dtype

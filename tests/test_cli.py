@@ -121,10 +121,10 @@ def test_checks_pp_flag_combinations(data_dir):
     with pytest.raises(ValueError, match="LLaMA-family"):
         get_args(["--data_dir", data_dir, "--run_type", "multi_chip",
                   "--shard_mode", "pp"])
-    with pytest.raises(ValueError, match="LoRA"):
+    with pytest.raises(ValueError, match="bf16/fp32 only"):
         get_args(["--data_dir", data_dir, "--run_type", "multi_chip",
                   "--model", "llama3_2", "--num_params", "1B",
-                  "--shard_mode", "pp", "--use_lora"])
+                  "--shard_mode", "pp", "--mixed_precision", "bf16_hybrid"])
     with pytest.raises(ValueError, match="divisible"):
         get_args(["--data_dir", data_dir, "--run_type", "multi_chip",
                   "--model", "llama3_2", "--num_params", "1B",

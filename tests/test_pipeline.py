@@ -106,6 +106,50 @@ def test_pp_training_matches_single_device():
     np.testing.assert_allclose(got_w, ref_w, rtol=2e-3, atol=2e-5)
 
 
+def test_pp_lora_matches_single_device():
+    """pp + LoRA: adapters merge before the stage split; losses match the
+    plain LoRA step and ONLY the adapters update."""
+    from building_llm_from_scratch_tpu.models.lora import init_lora_params
+
+    cfg = _cfg(n_layers=4)
+    mesh = make_pp_mesh(4)
+    opt = build_optimizer(peak_lr=1e-2, total_steps=10)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    # host snapshot: both states get their OWN device copies (the donated
+    # steps delete their input buffers — the aliasing footgun of VERDICT r2)
+    base_np = jax.tree_util.tree_map(np.asarray, params)
+    fresh_base = lambda: jax.tree_util.tree_map(jnp.asarray, base_np)
+    batches = [_batch(cfg, seed=s) for s in range(3)]
+
+    lora = init_lora_params(cfg, params, jax.random.PRNGKey(1), rank=4)
+    ref_state = init_train_state(lora, opt, jax.random.PRNGKey(0),
+                                 frozen=fresh_base())
+    ref_step = make_train_step(cfg, opt, lora_alpha=8, lora_rank=4)
+    ref_losses = []
+    for b in batches:
+        ref_state, m = ref_step(ref_state, b)
+        ref_losses.append(float(m["loss"]))
+
+    lora2 = init_lora_params(cfg, params, jax.random.PRNGKey(1), rank=4)
+    state = init_train_state(lora2, opt, jax.random.PRNGKey(0),
+                             frozen=fresh_base())
+    state = jax.device_put(state, stage_shardings(state, mesh))
+    step = make_pp_train_step(cfg, opt, mesh, n_micro=4, lora_alpha=8,
+                              lora_rank=4)
+    losses = []
+    for b in batches:
+        state, m = step(state, b)
+        losses.append(float(m["loss"]))
+
+    np.testing.assert_allclose(losses, ref_losses, rtol=2e-4, atol=2e-5)
+    # base stays frozen; adapters moved
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), b),
+        state["frozen"], base_np)
+    assert float(jnp.abs(
+        state["trainable"]["blocks"]["attn"]["wq"]["B"]).max()) > 0
+
+
 def test_pp_param_spec_for_weight_loading():
     """The weight-conversion path places each tensor via plan.param_spec:
     block leaves stage-shard their layer axis, non-divisible or non-block
