@@ -151,12 +151,43 @@ def _mlp(cfg: ModelConfig, p: Params, x: jnp.ndarray) -> jnp.ndarray:
     return h
 
 
+def _use_fused_dropout(shape) -> bool:
+    if jax.default_backend() != "tpu":
+        return False
+    from building_llm_from_scratch_tpu.ops.fused_dropout import supports_shape
+
+    return supports_shape(shape)
+
+
 def _dropout(x: jnp.ndarray, rate: float, rng: Optional[jax.Array],
              deterministic: bool) -> jnp.ndarray:
     if rate <= 0.0 or deterministic:
         return x
+    if _use_fused_dropout(x.shape):
+        from building_llm_from_scratch_tpu.ops.fused_dropout import (
+            fused_dropout,
+        )
+
+        return fused_dropout(x, rate, rng)
     keep = jax.random.bernoulli(rng, 1.0 - rate, x.shape)
     return jnp.where(keep, x / (1.0 - rate), jnp.zeros_like(x))
+
+
+def _residual_dropout(x: jnp.ndarray, h: jnp.ndarray, rate: float,
+                      rng: Optional[jax.Array],
+                      deterministic: bool) -> jnp.ndarray:
+    """x + dropout(h): the pre-norm residual update (reference
+    GPT2.py:79-87). On TPU the mask is drawn in-kernel (fused_dropout.py)
+    so it is never generated twice or stored for the backward."""
+    if rate <= 0.0 or deterministic:
+        return x + h
+    if _use_fused_dropout(h.shape):
+        from building_llm_from_scratch_tpu.ops.fused_dropout import (
+            fused_dropout_add,
+        )
+
+        return fused_dropout_add(x, h, rate, rng)
+    return x + _dropout(h, rate, rng, deterministic)
 
 
 def _attention(cfg: ModelConfig, p: Params, x: jnp.ndarray,
@@ -165,7 +196,7 @@ def _attention(cfg: ModelConfig, p: Params, x: jnp.ndarray,
                cache_kv: Optional[Tuple[jnp.ndarray, jnp.ndarray]],
                cache_len: Optional[jnp.ndarray],
                rng: Optional[jax.Array], deterministic: bool,
-               sp_mesh=None):
+               sp_mesh=None, sp_inside=None):
     """Per-block attention; returns (out, new_cache_kv)."""
     B, Tq, D = x.shape
     hd, Hq, Hkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_groups
@@ -201,19 +232,35 @@ def _attention(cfg: ModelConfig, p: Params, x: jnp.ndarray,
         kv_length = None
         q_positions = None
 
-    if sp_mesh is not None and cache_kv is None:
-        # sequence parallelism: the ring schedule owns the communication.
-        # Attention dropout has no per-shard formulation here (same
-        # restriction as the fused pallas kernel).
-        if cfg.drop_rate > 0.0 and not deterministic:
-            raise ValueError(
-                "sequence parallelism (--sp) does not support attention "
-                "dropout; set drop_rate=0 for this model")
+    if sp_inside is not None and cache_kv is None:
+        # already INSIDE a shard_map that mapped the seq axis (the explicit
+        # bf16_hybrid step): run the local ring body directly
+        from building_llm_from_scratch_tpu.ops.ring_attention import (
+            _ring_attention_local,
+        )
+        from building_llm_from_scratch_tpu.parallel.mesh import DATA_AXIS
+
+        axis_name, axis_size = sp_inside
+        dropout_on = cfg.drop_rate > 0.0 and not deterministic
+        out = _ring_attention_local(
+            q, k, v, axis_name=axis_name, axis_size=axis_size,
+            scale=1.0 / float(hd) ** 0.5,
+            dropout_rate=cfg.drop_rate if dropout_on else 0.0,
+            dropout_rng=rng if dropout_on else None,
+            shard_fold_axes=(DATA_AXIS,))
+    elif sp_mesh is not None and cache_kv is None:
+        # sequence parallelism: the ring schedule owns the communication;
+        # attention dropout folds shard indices into the mask PRNG (the
+        # round-3 restriction is lifted — ring_attention.py)
         from building_llm_from_scratch_tpu.ops.ring_attention import (
             ring_causal_attention,
         )
 
-        out = ring_causal_attention(q, k, v, sp_mesh)
+        dropout_on = cfg.drop_rate > 0.0 and not deterministic
+        out = ring_causal_attention(
+            q, k, v, sp_mesh,
+            dropout_rate=cfg.drop_rate if dropout_on else 0.0,
+            dropout_rng=rng if dropout_on else None)
     else:
         out = causal_attention(
             q, k, v,
@@ -232,7 +279,7 @@ def _attention(cfg: ModelConfig, p: Params, x: jnp.ndarray,
 
 def _block(cfg: ModelConfig, p: Params, x: jnp.ndarray,
            rope, positions, cache_kv, cache_len, rng, deterministic,
-           sp_mesh=None):
+           sp_mesh=None, sp_inside=None):
     """Pre-norm transformer block (reference GPT2.py:68-88, Llama3.py:159-181)."""
     if rng is not None:
         r_attn, r_res1, r_res2 = jax.random.split(rng, 3)
@@ -240,10 +287,11 @@ def _block(cfg: ModelConfig, p: Params, x: jnp.ndarray,
         r_attn = r_res1 = r_res2 = None
     h, new_cache = _attention(cfg, p["attn"], _norm(cfg, p["norm1"], x),
                               rope, positions, cache_kv, cache_len,
-                              r_attn, deterministic, sp_mesh=sp_mesh)
-    x = x + _dropout(h, cfg.drop_rate, r_res1, deterministic)
+                              r_attn, deterministic, sp_mesh=sp_mesh,
+                              sp_inside=sp_inside)
+    x = _residual_dropout(x, h, cfg.drop_rate, r_res1, deterministic)
     h = _mlp(cfg, p["mlp"], _norm(cfg, p["norm2"], x))
-    x = x + _dropout(h, cfg.drop_rate, r_res2, deterministic)
+    x = _residual_dropout(x, h, cfg.drop_rate, r_res2, deterministic)
     return x, new_cache
 
 
@@ -272,20 +320,15 @@ def _embed(cfg: ModelConfig, params: Params, tokens: jnp.ndarray,
     return _dropout(x, cfg.drop_rate, rng, deterministic)
 
 
-def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray, *,
-            rng: Optional[jax.Array] = None,
-            deterministic: bool = True,
-            sp_mesh=None) -> jnp.ndarray:
-    """Training/eval forward over full sequences.
-
-    tokens: (B, T) int32.  Returns fp32 logits (B, T, V).
-
-    ``sp_mesh``: a Mesh whose ``seq`` axis is > 1 switches attention to the
-    ring schedule (ops/ring_attention.py) — sequence parallelism for
-    long-context training. Everything else (embeddings, norms, MLPs, loss)
-    is token-local, so GSPMD shards it over the seq axis from the batch
-    sharding alone; only attention needs the explicit ring.
-    """
+def forward_hidden(params: Params, cfg: ModelConfig, tokens: jnp.ndarray, *,
+                   rng: Optional[jax.Array] = None,
+                   deterministic: bool = True,
+                   sp_mesh=None, sp_inside=None) -> jnp.ndarray:
+    """Forward up to (and including) the final norm — the (B, T, D) hidden
+    states BEFORE the output head. The training loss path consumes this
+    directly via ops/softmax_xent.py so (B, T, V) fp32 logits never
+    materialize; ``forward`` below adds the head for logits consumers
+    (generation, tests, golden-logit parity)."""
     L = cfg.n_layers
     rope = _rope_tables(cfg)
     if rng is None:
@@ -296,20 +339,49 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray, *,
         emb_rng, blocks_rng = jax.random.split(rng)
         layer_rngs = jax.random.split(blocks_rng, L)
 
-    x = _embed(cfg, params, tokens, None, emb_rng, deterministic)
+    if sp_inside is not None:
+        # inside a seq-mapped shard_map, ``tokens`` is this shard's T/S
+        # block: RoPE / learned positions must use the GLOBAL offsets
+        # my*Tl..(my+1)*Tl-1, not 0..Tl-1
+        axis_name, _ = sp_inside
+        Tl = tokens.shape[1]
+        positions = jax.lax.axis_index(axis_name) * Tl + jnp.arange(Tl)
+    else:
+        positions = None
+
+    x = _embed(cfg, params, tokens, positions, emb_rng, deterministic)
 
     def body(carry, layer):
         p, lrng = layer
         r = None if deterministic else lrng
-        y, _ = _block(cfg, p, carry, rope, None, None, None, r, deterministic,
-                      sp_mesh=sp_mesh)
+        y, _ = _block(cfg, p, carry, rope, positions, None, None, r,
+                      deterministic, sp_mesh=sp_mesh, sp_inside=sp_inside)
         return y, None
 
     if cfg.use_actv_ckpt:
         body = jax.checkpoint(body, prevent_cse=False)
 
     x, _ = jax.lax.scan(body, x, (params["blocks"], layer_rngs))
-    x = _norm(cfg, params["final_norm"], x)
+    return _norm(cfg, params["final_norm"], x)
+
+
+def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray, *,
+            rng: Optional[jax.Array] = None,
+            deterministic: bool = True,
+            sp_mesh=None, sp_inside=None) -> jnp.ndarray:
+    """Training/eval forward over full sequences.
+
+    tokens: (B, T) int32.  Returns fp32 logits (B, T, V).
+
+    ``sp_mesh``: a Mesh whose ``seq`` axis is > 1 switches attention to the
+    ring schedule (ops/ring_attention.py) — sequence parallelism for
+    long-context training. Everything else (embeddings, norms, MLPs, loss)
+    is token-local, so GSPMD shards it over the seq axis from the batch
+    sharding alone; only attention needs the explicit ring.
+    """
+    x = forward_hidden(params, cfg, tokens, rng=rng,
+                       deterministic=deterministic, sp_mesh=sp_mesh,
+                       sp_inside=sp_inside)
     logits = jnp.einsum("btd,dv->btv", x, params["head"]["weight"],
                         preferred_element_type=jnp.float32)
     return logits

@@ -5,7 +5,7 @@ One implementation surface replaces the reference's three attention classes:
   - MHA w/ RoPE               (Models/Llama/Llama2.py:61-114)
   - GroupedQueryAttention     (Models/Llama/Llama3.py:108-155)
 
-Three interchangeable implementations (``ModelConfig.attn_impl``):
+Interchangeable implementations (``ModelConfig.attn_impl``):
 
   xla     — einsum scores + masked softmax. Materializes the full
             (B, Hkv, G, Tq, Tkv) fp32 score tensor; exact, used for short
@@ -16,14 +16,19 @@ Three interchangeable implementations (``ModelConfig.attn_impl``):
             forward and backward instead of O(Tq · Tkv). Pure XLA: runs on
             CPU/TPU, differentiable, supports attention dropout (per-block
             folded PRNG).
-  pallas  — the fused TPU flash-attention kernel
-            (jax.experimental.pallas.ops.tpu.flash_attention): tiled
-            online-softmax in VMEM with custom fwd+bwd kernels and 512x512
-            blocks (measured best, table below). TPU only, no dropout; KV
-            heads are broadcast to query heads first.
-  auto    — on TPU with seq >= 2048 (no dropout): pallas; else flash for
-            block-divisible self-attention sequences; else xla. Thresholds
-            from the measured table below.
+  pallas  — the stock JAX pallas TPU kernel
+            (jax.experimental.pallas.ops.tpu.flash_attention) with 512x512
+            blocks. TPU only, no dropout. Kept as a cross-check; auto now
+            prefers the in-house ``fused`` kernel.
+  fused   — the in-house pallas kernel (ops/fused_attention.py): tiled
+            online-softmax with IN-KERNEL PRNG attention dropout, custom
+            fwd + dq + dkv kernels, causal block skipping, GQA via head
+            index mapping. The only fast path that carries the reference's
+            attention-dropout semantics (GPT2.py:30-41); measured 56.3ms ->
+            GPT2-124M headline step vs 64.5ms on flash (r4).
+  auto    — on TPU: fused for every block-divisible self-attention shape
+            (dropout or not); else flash for block-divisible sequences;
+            else xla.
 
 Measured fwd+bwd ms on v5e-1, bf16 (2026-07, this module's impls; pallas =
 512x512 blocks; best per row in [brackets]):
@@ -36,9 +41,10 @@ Measured fwd+bwd ms on v5e-1, bf16 (2026-07, this module's impls; pallas =
   L3.2   b4  t2048 H32/8 D64     18.7    16.2   [10.4]
   8B-ish b2  t4096 H32/8 D128    34.0    29.4   [11.8]
 
-  (*t1024 rows are within run-to-run noise of flash; auto keeps flash
-  below t2048 and switches to pallas at >= 2048 where the win is 1.6-2.5x
-  and reproducible.)
+  (*r3 table, kept for the stock-kernel cross-check. Since r4 auto routes
+  every block-divisible TPU training shape to the in-house ``fused``
+  kernel instead — measured in-model: GPT2-124M bf16 step 56.3ms fused vs
+  64.5ms flash at bs4, with identical dropout semantics.)
 
 TPU-first details shared by all paths:
   - no (ctx, ctx) mask *buffer*: the causal mask comes from position iota
@@ -59,7 +65,7 @@ import jax.numpy as jnp
 
 # Implementations currently wired up; args.py validates --attn_impl against
 # this so unimplemented choices fail at flag time, not mid-run.
-AVAILABLE_IMPLS = ("auto", "xla", "flash", "pallas")
+AVAILABLE_IMPLS = ("auto", "xla", "flash", "pallas", "fused")
 
 _NEG_INF = -1e30
 
@@ -88,11 +94,17 @@ def _resolve_impl(impl: str, Tq: int, Tkv: int, head_dim: int,
         return "xla"
     if impl != "auto":
         return impl
-    # auto, per the measured table in the module docstring: the fused pallas
-    # kernel wins 1.6-2.5x from seq 2048 up; flash wins/ties below that
-    if (_on_tpu() and not dropout_active and Tq == Tkv and Tq >= 2048
-            and Tq % 512 == 0 and head_dim % 64 == 0):
-        return "pallas"
+    # auto: on TPU the in-house fused kernel (ops/fused_attention.py) owns
+    # every block-divisible training shape — with OR without dropout (its
+    # in-kernel PRNG keeps T^2 masks out of HBM); flash/xla cover CPU and
+    # odd shapes
+    if _on_tpu():
+        from building_llm_from_scratch_tpu.ops.fused_attention import (
+            supports_shape,
+        )
+
+        if supports_shape(Tq, Tkv, head_dim):
+            return "fused"
     if Tq == Tkv and Tq >= 2 * block_q and Tq % block_q == 0:
         return "flash"
     return "xla"
@@ -263,6 +275,15 @@ def causal_attention(
     chosen = _resolve_impl(impl, Tq, Tkv, D, q_positions, kv_length,
                            dropout_active, block_q)
 
+    if chosen == "fused":
+        from building_llm_from_scratch_tpu.ops.fused_attention import (
+            fused_causal_attention,
+        )
+
+        return fused_causal_attention(
+            q, k, v,
+            dropout_rate=dropout_rate if dropout_active else 0.0,
+            dropout_rng=dropout_rng)
     if chosen == "pallas":
         if dropout_active:
             raise ValueError(

@@ -1,0 +1,157 @@
+"""Fused residual/embedding dropout for TPU.
+
+The reference drops the embedding output and both residual branches
+(/root/reference/Models/GPT2/GPT2.py:79-87,110-113). Under XLA those
+dropouts cost mask generation + storage across fwd/bwd; this kernel draws
+the Bernoulli mask from the per-core PRNG inside the kernel — seeded purely
+by (seed, tile index) — so the backward regenerates the exact mask and
+nothing mask-shaped is ever stored.
+
+Two entry points, one kernel body:
+  dropout(h, rate, rng)           -> dropout(h)          (embedding path)
+  dropout_add(x, h, rate, rng)    -> x + dropout(h)      (residual path)
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_WEYL = -1640531527  # 0x9E3779B9 as int32
+
+
+def _tile_keep(seed_ref, rate: float, shape):
+    tile = pl.program_id(0)
+    pltpu.prng_seed(seed_ref[0, 0],
+                    seed_ref[0, 1] + tile * jnp.int32(_WEYL))
+    bits = pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.uint32)
+    threshold = min(int(rate * (2 ** 32)), 2 ** 32 - 1)
+    return bits >= jnp.uint32(threshold)
+
+
+def _fwd_kernel(seed_ref, h_ref, o_ref, *, rate, add, x_ref=None):
+    keep = _tile_keep(seed_ref, rate, h_ref.shape[1:])
+    inv = 1.0 / (1.0 - rate)
+    h = jnp.where(keep, h_ref[0] * jnp.asarray(inv, h_ref.dtype),
+                  jnp.zeros_like(h_ref[0]))
+    o_ref[0] = (x_ref[0] + h) if add else h
+
+
+def _fwd_kernel_add(seed_ref, x_ref, h_ref, o_ref, *, rate):
+    _fwd_kernel(seed_ref, h_ref, o_ref, rate=rate, add=True, x_ref=x_ref)
+
+
+def _bwd_kernel(seed_ref, g_ref, dh_ref, *, rate):
+    keep = _tile_keep(seed_ref, rate, g_ref.shape[1:])
+    inv = 1.0 / (1.0 - rate)
+    dh_ref[0] = jnp.where(keep, g_ref[0] * jnp.asarray(inv, g_ref.dtype),
+                          jnp.zeros_like(g_ref[0]))
+
+
+_ROWS = 512
+
+
+def _tiles(h):
+    n, d = h.shape
+    r = min(_ROWS, n)
+    while n % r:
+        r -= 1
+    return n // r, r
+
+
+def _seed_spec():
+    return pl.BlockSpec((1, 2), lambda i: (0, 0), memory_space=pltpu.SMEM)
+
+
+def _call_fwd(x, h, seed, rate):
+    n_tiles, r = _tiles(h)
+    blk = pl.BlockSpec((1, r, h.shape[1]),
+                       lambda i: (i, 0, 0))
+    h3 = h.reshape(n_tiles, r, h.shape[1])
+    if x is None:
+        kern = functools.partial(_fwd_kernel, rate=rate, add=False)
+        args, specs = (seed, h3), [_seed_spec(), blk]
+    else:
+        kern = functools.partial(_fwd_kernel_add, rate=rate)
+        args = (seed, x.reshape(n_tiles, r, h.shape[1]), h3)
+        specs = [_seed_spec(), blk, blk]
+    out = pl.pallas_call(
+        kern, grid=(n_tiles,), in_specs=specs, out_specs=blk,
+        out_shape=jax.ShapeDtypeStruct(h3.shape, h.dtype),
+    )(*args)
+    return out.reshape(h.shape)
+
+
+def _call_bwd(g, seed, rate):
+    n_tiles, r = _tiles(g)
+    blk = pl.BlockSpec((1, r, g.shape[1]), lambda i: (i, 0, 0))
+    g3 = g.reshape(n_tiles, r, g.shape[1])
+    dh = pl.pallas_call(
+        functools.partial(_bwd_kernel, rate=rate),
+        grid=(n_tiles,), in_specs=[_seed_spec(), blk], out_specs=blk,
+        out_shape=jax.ShapeDtypeStruct(g3.shape, g.dtype),
+    )(seed, g3)
+    return dh.reshape(g.shape)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _dropout_add2d(x, h, seed, rate):
+    return _call_fwd(x, h, seed, rate)
+
+
+def _da_fwd(x, h, seed, rate):
+    return _call_fwd(x, h, seed, rate), seed
+
+
+def _da_bwd(rate, seed, g):
+    return g, _call_bwd(g, seed, rate), None
+
+
+_dropout_add2d.defvjp(_da_fwd, _da_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _dropout2d(h, seed, rate):
+    return _call_fwd(None, h, seed, rate)
+
+
+def _d_fwd(h, seed, rate):
+    return _call_fwd(None, h, seed, rate), seed
+
+
+def _d_bwd(rate, seed, g):
+    return _call_bwd(g, seed, rate), None
+
+
+_dropout2d.defvjp(_d_fwd, _d_bwd)
+
+
+def supports_shape(shape) -> bool:
+    """Last dim lane-aligned and leading dims foldable."""
+    return len(shape) >= 2 and shape[-1] % 128 == 0
+
+
+def _seed_from_rng(rng):
+    return jax.random.bits(rng, (1, 2), jnp.uint32).astype(jnp.int32)
+
+
+def fused_dropout(h: jnp.ndarray, rate: float, rng: jax.Array) -> jnp.ndarray:
+    """dropout(h) with the mask drawn in-kernel (never stored)."""
+    shape = h.shape
+    out = _dropout2d(h.reshape(-1, shape[-1]), _seed_from_rng(rng),
+                     float(rate))
+    return out.reshape(shape)
+
+
+def fused_dropout_add(x: jnp.ndarray, h: jnp.ndarray, rate: float,
+                      rng: jax.Array) -> jnp.ndarray:
+    """x + dropout(h) — the pre-norm residual update — in one pass."""
+    shape = h.shape
+    out = _dropout_add2d(x.reshape(-1, shape[-1]),
+                         h.reshape(-1, shape[-1]),
+                         _seed_from_rng(rng), float(rate))
+    return out.reshape(shape)

@@ -8,19 +8,30 @@ Note: this environment's sitecustomize registers a TPU PJRT plugin and pins
 ``JAX_PLATFORMS=axon`` at interpreter startup, so plain env vars are not
 enough — we must flip ``jax_platforms`` via jax.config after import (backends
 initialize lazily, so the XLA_FLAGS below still take effect).
+
+Set ``RUN_TPU_TESTS=1`` to SKIP the CPU forcing and run on the real chip
+instead — this enables the ``@needs_tpu`` pallas-kernel tests
+(test_fused_attention.py, the pallas cases in test_attention_impls.py) that
+skip on the virtual CPU mesh:
+
+  RUN_TPU_TESTS=1 python -m pytest tests/test_fused_attention.py -q
 """
 
 import os
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+RUN_ON_TPU = os.environ.get("RUN_TPU_TESTS") == "1"
+
+if not RUN_ON_TPU:
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if not RUN_ON_TPU:
+    jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
@@ -31,7 +42,10 @@ def rng_key():
 
 
 @pytest.fixture(scope="session", autouse=True)
-def _assert_cpu_backend():
-    assert jax.default_backend() == "cpu"
-    assert len(jax.devices()) == 8
+def _assert_backend():
+    if RUN_ON_TPU:
+        assert jax.default_backend() == "tpu"
+    else:
+        assert jax.default_backend() == "cpu"
+        assert len(jax.devices()) == 8
     yield

@@ -128,9 +128,9 @@ def test_pallas_gradients_match_xla_on_tpu():
 
 
 def test_auto_selection_policy():
-    """auto follows the measured table: xla for decode/q_positions, pallas
-    only on TPU at seq >= 2048, flash for long block-divisible training
-    shapes, xla otherwise."""
+    """auto: xla for decode/q_positions; on TPU the in-house fused kernel
+    (dropout included) owns block-divisible training shapes; flash covers
+    CPU and odd shapes; xla otherwise."""
     from building_llm_from_scratch_tpu.ops.attention import _resolve_impl
 
     on_tpu = jax.default_backend() == "tpu"
@@ -141,15 +141,14 @@ def test_auto_selection_policy():
                          256) == "xla"
     assert _resolve_impl("pallas", 64, 64, 64, jnp.arange(64), None, False,
                          256) == "xla"
-    # training shapes
+    # training shapes: fused on TPU (with or without dropout), flash on CPU
+    expect_train = "fused" if on_tpu else "flash"
     assert _resolve_impl("auto", 1024, 1024, 64, None, None, False,
-                         256) == "flash"
-    expect_long = "pallas" if on_tpu else "flash"
+                         256) == expect_train
     assert _resolve_impl("auto", 2048, 2048, 64, None, None, False,
-                         256) == expect_long
-    # dropout disqualifies the pallas kernel
+                         256) == expect_train
     assert _resolve_impl("auto", 2048, 2048, 64, None, None, True,
-                         256) == "flash"
+                         256) == expect_train
     # short sequences stay exact
     assert _resolve_impl("auto", 128, 128, 64, None, None, False,
                          256) == "xla"
