@@ -66,12 +66,17 @@ def strip_gutenberg_boilerplate(text: str) -> str:
     end = len(lines)
     # the opening marker legitimately appears only near the top; scanning
     # the whole file could hit quoted markers inside the book text
+    # first start marker / last end marker win (pgcorpus strip_headers
+    # behavior): without the breaks, a quoted marker line inside the book
+    # text would silently truncate real content
     for i, line in enumerate(lines[:600]):
         if any(m in line for m in _START_MARKERS):
             start = i + 1
+            break
     for i in range(len(lines) - 1, max(start, len(lines) - 600) - 1, -1):
         if any(m in lines[i] for m in _END_MARKERS):
             end = i
+            break
     return "".join(lines[start:end])
 
 

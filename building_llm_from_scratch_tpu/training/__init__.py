@@ -23,6 +23,7 @@ from building_llm_from_scratch_tpu.training.checkpoint import (
     load_checkpoint,
     load_exported_params,
     save_checkpoint,
+    save_checkpoint_gathered,
 )
 from building_llm_from_scratch_tpu.training.trainer import Trainer
 
@@ -43,5 +44,6 @@ __all__ = [
     "load_checkpoint",
     "load_exported_params",
     "save_checkpoint",
+    "save_checkpoint_gathered",
     "Trainer",
 ]

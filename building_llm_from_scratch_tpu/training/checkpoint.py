@@ -5,14 +5,21 @@ resume path anywhere (train.py:231-257, SURVEY.md §5). This module provides
 the full design the reference lacks while keeping its export semantics:
 
   - ``save_checkpoint`` / ``load_checkpoint``: the COMPLETE train state
-    (trainable + frozen params, optax state, step, rng) as one .npy file per
-    leaf + a JSON manifest — a resumable checkpoint. Only process 0 writes
-    (the reference's rank-0-save-with-barriers pattern, train.py:232-240);
-    restore can place leaves directly onto a target sharding so large models
-    never materialize unsharded on one chip.
+    (trainable + frozen params, optax state, step, rng), SHARDED: every
+    process writes only its addressable shards (one ``.npy`` per unique
+    shard, deduplicated across replicas) plus a JSON manifest — an
+    Orbax-style resumable checkpoint (SURVEY.md §5 target). Peak host
+    memory is ONE SHARD on both save and restore; nothing is gathered.
+    Restore streams shard files (mmap) straight onto a target sharding —
+    which may differ from the save-time sharding (any slice of the global
+    array is assembled from the files that cover it), so an fsdp-8 run can
+    restore into a dp-4 run. Requires the checkpoint dir to be on storage
+    every process can reach (the norm for pod slices).
+  - ``load_checkpoint`` also still reads the round-3 gathered format
+    (one full .npy per leaf) for backward compatibility.
   - ``export_params`` / ``load_exported_params``: a single ``.npz`` of just
-    the model params — the analog of the reference's final
-    ``model_pg_final.pth`` full-state-dict export (main.py:171-172).
+    the model params, gathered to process 0 — the analog of the reference's
+    final ``model_pg_final.pth`` full-state-dict export (main.py:171-172).
 """
 
 from __future__ import annotations
@@ -29,6 +36,8 @@ from building_llm_from_scratch_tpu.utils.logging import setup_logger
 logger = setup_logger(__name__)
 
 Params = Dict[str, Any]
+
+_SHARDED_FORMAT = "sharded-v1"
 
 
 def _restore_dtype(arr: np.ndarray, dtype_name: str) -> np.ndarray:
@@ -56,18 +65,80 @@ def _path_str(path) -> str:
     return "/".join(parts)
 
 
+def _norm_index(index, shape):
+    """Serialize a devices_indices_map index (tuple of slices) as
+    [[start, stop], ...] with Nones resolved against the global shape."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        out.append([start, stop])
+    return out
+
+
+def _unique_shards(leaf):
+    """(owner_device, index) per UNIQUE shard of a jax.Array: replicas are
+    deduplicated; the device with the lowest id in each replica group owns
+    the write."""
+    shape = leaf.shape
+    index_map = leaf.sharding.devices_indices_map(shape)
+    groups: Dict[tuple, list] = {}
+    for dev, index in index_map.items():
+        key = tuple(tuple(b) for b in _norm_index(index, shape))
+        groups.setdefault(key, []).append(dev)
+    out = []
+    for key in sorted(groups):
+        devs = groups[key]
+        owner = min(devs, key=lambda d: d.id)
+        out.append((owner, key))
+    return out
+
+
 def save_checkpoint(ckpt_dir: str, state: Params,
                     extra_metadata: Optional[dict] = None) -> str:
-    """Write every leaf of ``state`` plus a manifest. Returns the dir.
+    """Write ``state`` as a SHARDED checkpoint. Returns the dir.
 
-    Each leaf goes through ``gather_full`` so fsdp/zero1-sharded state on a
-    multi-host mesh (non-addressable arrays, where a bare device_get
-    raises) is reassembled via process_allgather before process 0 writes —
-    the reference's FULL_STATE_DICT rank-0 gather (train.py:244-249).
-    Gathering happens ONE LEAF AT A TIME inside the loop (every process
-    iterates leaves in the same order, so the collectives line up) to keep
-    peak host RAM at one full leaf, not the whole state.
+    Every process writes the unique shards it owns (lowest-device-id
+    replica wins, so replicated leaves are written exactly once across the
+    job); process 0 writes the manifest. Nothing is gathered — peak host
+    memory is one shard. All processes must see the same filesystem.
     """
+    is_proc0 = jax.process_index() == 0
+    leaves = jax.tree_util.tree_flatten_with_path(state)[0]
+    os.makedirs(ckpt_dir, exist_ok=True)
+    local_ids = {d.id for d in jax.local_devices()}
+    manifest = {"format": _SHARDED_FORMAT, "leaves": [],
+                "metadata": extra_metadata or {}}
+    for i, (path, leaf) in enumerate(leaves):
+        leaf = jnp_asarray(leaf)
+        shards_meta = []
+        by_device = {s.device.id: s for s in leaf.addressable_shards}
+        for k, (owner, index_key) in enumerate(_unique_shards(leaf)):
+            fname = f"leaf_{i:05d}.shard_{k:03d}.npy"
+            shards_meta.append({"file": fname,
+                                "index": [list(se) for se in index_key]})
+            if owner.id in local_ids:
+                np.save(os.path.join(ckpt_dir, fname),
+                        np.asarray(by_device[owner.id].data))
+        manifest["leaves"].append({
+            "index": i,
+            "path": _path_str(path),
+            "shape": list(leaf.shape),
+            "dtype": str(leaf.dtype),
+            "shards": shards_meta,
+        })
+    if is_proc0:
+        with open(os.path.join(ckpt_dir, "manifest.json"), "w") as f:
+            json.dump(manifest, f, indent=1)
+    return ckpt_dir
+
+
+def save_checkpoint_gathered(ckpt_dir: str, state: Params,
+                             extra_metadata: Optional[dict] = None) -> str:
+    """The round-3 format: every leaf gathered full and written by process
+    0 (the reference's FULL_STATE_DICT rank-0 gather, train.py:244-249).
+    Kept for interop with round-3 checkpoints and as the compat-path test
+    fixture; ``save_checkpoint`` (sharded) is the default."""
     from building_llm_from_scratch_tpu.parallel.collectives import gather_full
 
     is_writer = jax.process_index() == 0
@@ -76,7 +147,6 @@ def save_checkpoint(ckpt_dir: str, state: Params,
         os.makedirs(ckpt_dir, exist_ok=True)
     manifest = {"leaves": [], "metadata": extra_metadata or {}}
     for i, (path, leaf) in enumerate(leaves):
-        name = f"leaf_{i:05d}"
         arr = np.asarray(gather_full(leaf))
         manifest["leaves"].append({
             "index": i,
@@ -85,11 +155,56 @@ def save_checkpoint(ckpt_dir: str, state: Params,
             "dtype": str(arr.dtype),
         })
         if is_writer:
-            np.save(os.path.join(ckpt_dir, name + ".npy"), arr)
+            np.save(os.path.join(ckpt_dir, f"leaf_{i:05d}.npy"), arr)
     if is_writer:
         with open(os.path.join(ckpt_dir, "manifest.json"), "w") as f:
             json.dump(manifest, f, indent=1)
     return ckpt_dir
+
+
+def jnp_asarray(leaf):
+    """Leaves like python ints (step counters built outside jit) become
+    committed jax arrays so sharding introspection works uniformly."""
+    if isinstance(leaf, jax.Array):
+        return leaf
+    import jax.numpy as jnp
+
+    return jnp.asarray(leaf)
+
+
+def _read_leaf_slice(ckpt_dir: str, meta: dict, index) -> np.ndarray:
+    """Assemble an arbitrary slice of a leaf from its shard files (mmap —
+    only the bytes covering the request are read)."""
+    shape = tuple(meta["shape"])
+    bounds = _norm_index(index, shape)
+    target_shape = tuple(b[1] - b[0] for b in bounds)
+    dtype = np.dtype(meta["dtype"])
+    # fast path: a single shard exactly matches the request
+    for sh in meta["shards"]:
+        if [list(map(int, b)) for b in sh["index"]] == bounds:
+            arr = np.load(os.path.join(ckpt_dir, sh["file"]))
+            return _restore_dtype(arr, meta["dtype"])
+    out = np.empty(target_shape, dtype)
+    filled = 0
+    for sh in meta["shards"]:
+        s_bounds = sh["index"]
+        # overlap of shard box and requested box, per dim
+        lo = [max(a[0], b[0]) for a, b in zip(s_bounds, bounds)]
+        hi = [min(a[1], b[1]) for a, b in zip(s_bounds, bounds)]
+        if any(l >= h for l, h in zip(lo, hi)):
+            continue
+        src = np.load(os.path.join(ckpt_dir, sh["file"]), mmap_mode="r")
+        src = _restore_dtype(np.asarray(src[tuple(
+            slice(l - sb[0], h - sb[0])
+            for l, h, sb in zip(lo, hi, s_bounds))]), meta["dtype"])
+        out[tuple(slice(l - b[0], h - b[0])
+                  for l, h, b in zip(lo, hi, bounds))] = src
+        filled += src.size
+    if filled < int(np.prod(target_shape)):
+        raise ValueError(
+            f"Checkpoint shards for leaf '{meta['path']}' do not cover the "
+            f"requested slice {bounds} — incomplete checkpoint?")
+    return out
 
 
 def load_checkpoint(ckpt_dir: str, template_state: Params,
@@ -99,10 +214,16 @@ def load_checkpoint(ckpt_dir: str, template_state: Params,
     ``template_state`` (e.g. a freshly initialized state) supplies the
     pytree structure; leaf paths are cross-checked against the manifest.
     If ``shardings`` (a matching pytree of jax.sharding.Sharding) is given,
-    each leaf is device_put directly to its target placement.
+    each leaf lands directly on its target placement — for sharded-v1
+    checkpoints each process reads ONLY the bytes its devices need
+    (restore-time sharding may differ from save-time sharding).
+
+    Handles both the sharded-v1 format and the round-3 gathered format
+    (full ``leaf_NNNNN.npy`` files).
     """
     with open(os.path.join(ckpt_dir, "manifest.json")) as f:
         manifest = json.load(f)
+    sharded = manifest.get("format") == _SHARDED_FORMAT
     flat, treedef = jax.tree_util.tree_flatten_with_path(template_state)
     if len(flat) != len(manifest["leaves"]):
         raise ValueError(
@@ -120,7 +241,10 @@ def load_checkpoint(ckpt_dir: str, template_state: Params,
         tmpl_shape = tuple(getattr(tmpl, "shape", ()))
         tmpl_dtype = str(getattr(tmpl, "dtype", ""))
         if tuple(meta["shape"]) != tmpl_shape:
-            if meta["path"].endswith("rng"):
+            # exactly the train-state PRNG leaf (state["rng"]) — an
+            # endswith match would also catch unrelated leaves whose name
+            # merely ends in "rng" and silently skip their structure check
+            if meta["path"] == "rng":
                 # PRNG keys are impl-specific (threefry (2,) vs rbg (4,)
                 # uint32); a checkpoint written under a different default
                 # impl cannot restore its dropout stream — keep the
@@ -142,8 +266,21 @@ def load_checkpoint(ckpt_dir: str, template_state: Params,
                 f"Checkpoint leaf '{meta['path']}' has dtype "
                 f"{meta['dtype']} but the model expects {tmpl_dtype} "
                 "— was the checkpoint written with a different --data_type?")
-        arr = np.load(os.path.join(ckpt_dir, f"leaf_{meta['index']:05d}.npy"))
-        arr = _restore_dtype(arr, meta["dtype"])
+        if sharded and shard is not None:
+            # stream shard files straight onto the target sharding: the
+            # callback is invoked once per addressable shard index
+            arr = jax.make_array_from_callback(
+                tuple(meta["shape"]), shard,
+                lambda idx, meta=meta: _read_leaf_slice(ckpt_dir, meta, idx))
+            loaded.append(arr)
+            continue
+        if sharded:
+            full_idx = tuple(slice(0, d) for d in meta["shape"])
+            arr = _read_leaf_slice(ckpt_dir, meta, full_idx)
+        else:
+            arr = np.load(os.path.join(ckpt_dir,
+                                       f"leaf_{meta['index']:05d}.npy"))
+            arr = _restore_dtype(arr, meta["dtype"])
         if shard is not None:
             loaded.append(jax.device_put(arr, shard))
         else:

@@ -59,11 +59,16 @@ def log_device_memory(logger, prefix: str = "") -> None:
             # remote/tunnel backends expose no live stats; fall back to the
             # size of this process's live arrays on the device — an in-use
             # floor, not a peak
+            # sum the actual shard bytes resident on THIS device: dividing
+            # global nbytes by the device count undercounts replicated
+            # arrays (each replica holds the FULL buffer)
             live = sum(
-                x.nbytes / len(x.sharding.device_set)   # this device's share
+                s.data.nbytes
                 for x in jax.live_arrays()
                 if getattr(x, "sharding", None) is not None
-                and d in x.sharding.device_set) / 1024**3
+                and d in x.sharding.device_set
+                for s in x.addressable_shards
+                if s.device == d) / 1024**3
             logger.info("%s%s: live stats unavailable; live jax.Arrays "
                         "hold >= %.2fGB", prefix, d, live)
             continue

@@ -25,6 +25,7 @@ import numpy as np  # noqa: E402
 def main():
     pid, nproc, port, ckdir = (int(sys.argv[1]), int(sys.argv[2]),
                                sys.argv[3], sys.argv[4])
+    mode = sys.argv[5] if len(sys.argv) > 5 else "fsdp"
     from building_llm_from_scratch_tpu.parallel import (
         build_mesh_plan,
         gather_full,
@@ -49,13 +50,18 @@ def main():
 
     cfg = get_config("GPT2", "124M", debug=True).replace(
         emb_dim=64, hidden_dim=128, vocab_size=256, drop_rate=0.0)
-    plan = build_mesh_plan("fsdp")
-    params = init_params(cfg, jax.random.PRNGKey(0))   # same on both procs
+    plan = build_mesh_plan(mode)
+    params = init_params(cfg, jax.random.PRNGKey(0))   # same on all procs
     opt = build_optimizer(total_steps=10)
     state = plan.shard_state(
         init_train_state(params, opt, jax.random.PRNGKey(0)))
-    wq = state["trainable"]["blocks"]["attn"]["wq"]
-    assert not wq.is_fully_addressable            # really spans both hosts
+    if mode == "fsdp":
+        wq = state["trainable"]["blocks"]["attn"]["wq"]
+        assert not wq.is_fully_addressable        # really spans all hosts
+    else:                                         # zero1: only opt state
+        mu = jax.tree_util.tree_leaves(state["opt_state"])
+        assert any(getattr(x, "is_fully_addressable", True) is False
+                   for x in mu if hasattr(x, "sharding"))
     step = make_train_step(cfg, opt)
 
     rng = np.random.default_rng(0)
@@ -91,6 +97,19 @@ def main():
         gather_full(restored["trainable"])["blocks"]["attn"]["wq"],
         full["blocks"]["attn"]["wq"])
     assert int(restored["step"]) == 3
+
+    # RESUME: training continues from the restored state (the path the
+    # reference lacks entirely, SURVEY.md §5)
+    x = rng.integers(0, cfg.vocab_size,
+                     (4, cfg.context_length)).astype(np.int32)
+    batch = plan.shard_batch({
+        "inputs": x,
+        "targets": np.roll(x, -1, 1).astype(np.int32),
+        "weights": np.ones_like(x, np.float32),
+    })
+    restored, m = step(restored, batch)
+    assert np.isfinite(float(m["loss"]))
+    assert int(restored["step"]) == 4
     sync_global_devices("done")
     print(f"WORKER_{pid}_OK", flush=True)
 
