@@ -111,13 +111,11 @@ def perform_checks(args) -> None:
     if args.sp > 1:
         if args.run_type != "multi_chip":
             raise ValueError("--sp > 1 requires --run_type multi_chip.")
-        if args.model == "GPT2":
-            # ring attention has no per-shard attention-dropout stream and
-            # GPT-2 configs train with dropout 0.1 (transformer.py raises
-            # the same constraint at trace time)
-            raise ValueError(
-                "--sp > 1 is not supported for GPT2 (attention dropout); "
-                "use a LLaMA-family model.")
+        # GPT-2 (attention dropout) composes with --sp since round 4: the
+        # ring schedule folds shard indices into the mask PRNG
+        # (ops/ring_attention.py), and --mixed_precision bf16_hybrid
+        # composes via the seq-mapped explicit-psum step
+        # (train_step.make_sharded_train_step).
 
     if args.finetune and args.dataset == "gutenberg":
         raise ValueError(

@@ -106,10 +106,12 @@ def test_cli_multichip_sequence_parallel(data_dir, tmp_path):
     _run_shardmap_worker("sp", data_dir, tmp_path)
 
 
-def test_checks_sp_rejects_gpt2_dropout(data_dir):
-    with pytest.raises(ValueError, match="attention dropout"):
-        get_args(["--data_dir", data_dir, "--run_type", "multi_chip",
-                  "--sp", "2"])
+def test_checks_sp_accepts_gpt2_dropout(data_dir):
+    """Since round 4 the ring schedule supports attention dropout
+    (per-shard folded mask PRNG), so GPT-2 + --sp is accepted."""
+    args = get_args(["--data_dir", data_dir, "--run_type", "multi_chip",
+                     "--sp", "2"])
+    assert args.sp == 2 and args.model == "GPT2"
 
 
 def test_cli_multichip_pipeline(data_dir, tmp_path):
