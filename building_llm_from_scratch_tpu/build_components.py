@@ -184,6 +184,19 @@ def build_components(args) -> Components:
         logger.info("Runtime params+grads estimate: %.2f GB",
                     estimate_memory_dynamic(n_params, n_params, cfg.dtype))
 
+    if (args.model != "GPT2" and args.tokenizer_path is None
+            and not args.byte_tokenizer and jax.process_count() > 1):
+        # coordinator-first tokenizer-asset download (reference's rank
+        # barrier dance, build_components.py:265-300): the coordinator
+        # populates the shared HF cache with a LOCAL-only download, then
+        # everyone resolves from the cache after the barrier
+        from building_llm_from_scratch_tpu.data.tokenizers import (
+            fetch_tokenizer_asset,
+        )
+
+        if is_coordinator():
+            fetch_tokenizer_asset(args.model)
+        sync_global_devices("tokenizer_download")
     tokenizer = build_tokenizer(args.model, args.tokenizer_path,
                                 fallback_byte=args.byte_tokenizer)
 

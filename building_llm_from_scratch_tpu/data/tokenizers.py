@@ -164,29 +164,70 @@ class Llama3Tokenizer:
         return self._enc.decode(list(int(i) for i in ids))
 
 
+# (repo_id, filename) for each LLaMA family's tokenizer asset — the same
+# repos the reference pulls from behind its rank barriers
+# (build_components.py:265-300); llama3* keep Meta's original BPE file.
+HF_TOKENIZER_ASSETS = {
+    "llama2": ("meta-llama/Llama-2-7b", "tokenizer.model"),
+    "llama3": ("meta-llama/Meta-Llama-3-8B", "original/tokenizer.model"),
+    "llama3_1": ("meta-llama/Llama-3.1-8B", "original/tokenizer.model"),
+    "llama3_2": ("meta-llama/Llama-3.2-1B", "original/tokenizer.model"),
+}
+
+
+def fetch_tokenizer_asset(model: str,
+                          cache_dir: str = "hf_checkpoints") -> str:
+    """Download (cache-if-exists) the tokenizer asset for a LLaMA family.
+
+    Local-only side effects — on multi-host runs the coordinator calls this
+    BEFORE the shared barrier and every process re-resolves from the
+    populated cache afterwards (same dance as weights/fetch.py's
+    ``download_hf_weights``).
+    """
+    if model not in HF_TOKENIZER_ASSETS:
+        raise ValueError(f"No tokenizer asset mapping for model '{model}'")
+    repo_id, filename = HF_TOKENIZER_ASSETS[model]
+    from huggingface_hub import hf_hub_download
+
+    return hf_hub_download(repo_id=repo_id, filename=filename,
+                           cache_dir=cache_dir)
+
+
 def build_tokenizer(model: str, tokenizer_path: Optional[str] = None,
-                    fallback_byte: bool = False):
+                    fallback_byte: bool = False,
+                    cache_dir: str = "hf_checkpoints"):
     """Tokenizer factory (reference build_components.py:265-300).
 
-    The reference downloads tokenizer assets from HF hub behind rank barriers;
-    in offline environments pass ``tokenizer_path`` to local assets, or set
-    ``fallback_byte=True`` (debug/smoke runs) to get the ByteTokenizer.
+    LLaMA tokenizer assets auto-download from HF hub when ``tokenizer_path``
+    is not given (cache-if-exists), so ``--model llama3_2 --load_weights``
+    runs as one command the way the reference does. ``tokenizer_path``
+    remains the offline override; ``fallback_byte=True`` (debug/smoke runs)
+    degrades to the ByteTokenizer on any failure.
     """
+    if fallback_byte and model != "GPT2" and tokenizer_path is None:
+        # debug/smoke runs must not touch the network at all: without this
+        # short-circuit an offline --byte_tokenizer run would block on hub
+        # DNS/connect timeouts before degrading
+        return ByteTokenizer()
+
+    def _asset_path() -> str:
+        if tokenizer_path is not None:
+            return tokenizer_path
+        try:
+            return fetch_tokenizer_asset(model, cache_dir=cache_dir)
+        except Exception as e:
+            raise FileNotFoundError(
+                f"{model} tokenizer assets unavailable: hub download "
+                f"failed ({type(e).__name__}); pass --tokenizer_path to a "
+                "local tokenizer.model for offline runs") from e
+
     try:
         if model == "GPT2":
             return GPT2Tokenizer()
         if model == "llama2":
-            if tokenizer_path is None:
-                raise FileNotFoundError(
-                    "llama2 requires --tokenizer_path to a sentencepiece "
-                    "tokenizer.model")
-            return Llama2Tokenizer(tokenizer_path)
+            return Llama2Tokenizer(_asset_path())
         if model in ("llama3", "llama3_1", "llama3_2"):
-            if tokenizer_path is None:
-                raise FileNotFoundError(
-                    f"{model} requires --tokenizer_path to Meta's "
-                    "tokenizer.model")
-            return Llama3Tokenizer(tokenizer_path)
+            return Llama3Tokenizer(_asset_path())
     except Exception:
         if fallback_byte:
             return ByteTokenizer()

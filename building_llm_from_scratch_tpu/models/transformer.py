@@ -190,6 +190,36 @@ def _residual_dropout(x: jnp.ndarray, h: jnp.ndarray, rate: float,
     return x + _dropout(h, rate, rng, deterministic)
 
 
+def _qkv_proj(cfg: ModelConfig, p: Params, x: jnp.ndarray,
+              rope, positions):
+    """Shared q/k/v projection (+biases, head reshape, RoPE) — the single
+    source of truth for the attention parameterization, used by BOTH the
+    training path (_attention) and the KV-cache decode body
+    (forward_with_cache); divergence here would silently break decode."""
+    B, Tq, _ = x.shape
+    hd, Hq, Hkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_groups
+    q = x @ p["wq"]
+    k = x @ p["wk"]
+    v = x @ p["wv"]
+    if "bq" in p:
+        q, k, v = q + p["bq"], k + p["bk"], v + p["bv"]
+    q = q.reshape(B, Tq, Hq, hd)
+    k = k.reshape(B, Tq, Hkv, hd)
+    v = v.reshape(B, Tq, Hkv, hd)
+    if rope is not None:
+        cos, sin = rope
+        q = apply_rope(q, cos, sin, positions)
+        k = apply_rope(k, cos, sin, positions)
+    return q, k, v
+
+
+def _attn_out_proj(p: Params, out: jnp.ndarray, B: int, Tq: int) -> jnp.ndarray:
+    out = out.reshape(B, Tq, -1) @ p["wo"]
+    if "bo" in p:
+        out = out + p["bo"]
+    return out
+
+
 def _attention(cfg: ModelConfig, p: Params, x: jnp.ndarray,
                rope: Optional[Tuple[jnp.ndarray, jnp.ndarray]],
                positions: Optional[jnp.ndarray],
@@ -199,21 +229,9 @@ def _attention(cfg: ModelConfig, p: Params, x: jnp.ndarray,
                sp_mesh=None, sp_inside=None):
     """Per-block attention; returns (out, new_cache_kv)."""
     B, Tq, D = x.shape
-    hd, Hq, Hkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_groups
+    hd = cfg.head_dim
 
-    q = x @ p["wq"]
-    k = x @ p["wk"]
-    v = x @ p["wv"]
-    if "bq" in p:
-        q, k, v = q + p["bq"], k + p["bk"], v + p["bv"]
-    q = q.reshape(B, Tq, Hq, hd)
-    k = k.reshape(B, Tq, Hkv, hd)
-    v = v.reshape(B, Tq, Hkv, hd)
-
-    if rope is not None:
-        cos, sin = rope
-        q = apply_rope(q, cos, sin, positions)
-        k = apply_rope(k, cos, sin, positions)
+    q, k, v = _qkv_proj(cfg, p, x, rope, positions)
 
     new_cache = None
     if cache_kv is not None:
@@ -271,9 +289,7 @@ def _attention(cfg: ModelConfig, p: Params, x: jnp.ndarray,
             deterministic=deterministic,
             impl=cfg.attn_impl,
         )
-    out = out.reshape(B, Tq, Hq * hd) @ p["wo"]
-    if "bo" in p:
-        out = out + p["bo"]
+    out = _attn_out_proj(p, out, B, Tq)
     return out, new_cache
 
 
@@ -407,27 +423,52 @@ def forward_with_cache(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     ``cache['length']`` valid positions; returns (fp32 logits (B, Tq, V),
     updated cache). Static shapes throughout — jit-friendly.
 
+    The full stacked (L, B, Tmax, Hkv, hd) k/v buffers travel through the
+    layer scan as CARRY (each layer dynamic-update-slices its row in
+    place). The previous design scanned per-layer cache slices as xs and
+    restacked them as ys, which made XLA materialize gather+stack copies of
+    the entire cache every token — measured 0.39 ms/step of pure copies on
+    the GPT2-124M decode profile (r4).
+
     Contract: the caller must ensure ``cache['length'] + Tq <= max_length``
     (the cache allocation). Under jit an overflow cannot raise —
     ``dynamic_update_slice`` would clamp the write offset and silently
     overwrite the newest entries. The generation loop sizes its cache to
-    ``prompt_len + max_new_tokens`` so this never triggers.
+    cover the full decode so this never triggers.
     """
     rope = _rope_tables(cfg)
     length = cache["length"]
-    Tq = tokens.shape[1]
+    B, Tq = tokens.shape
     positions = length + jnp.arange(Tq)
 
     x = _embed(cfg, params, tokens, positions, None, True)
 
     def body(carry, layer):
-        p, ck, cv = layer
-        y, new_kv = _block(cfg, p, carry, rope, positions, (ck, cv), length,
-                           None, True)
-        return y, new_kv
+        x, K, V = carry
+        p, l = layer
+        h = _norm(cfg, p["norm1"], x)
+        q, k, v = _qkv_proj(cfg, p["attn"], h, rope, positions)
+        K = jax.lax.dynamic_update_slice(K, k[None].astype(K.dtype),
+                                         (l, 0, length, 0, 0))
+        V = jax.lax.dynamic_update_slice(V, v[None].astype(V.dtype),
+                                         (l, 0, length, 0, 0))
+        kf = jax.lax.dynamic_index_in_dim(K, l, 0, keepdims=False)
+        vf = jax.lax.dynamic_index_in_dim(V, l, 0, keepdims=False)
+        out = causal_attention(q, kf, vf, q_positions=positions,
+                               kv_length=length + Tq,
+                               impl=cfg.attn_impl)
+        x = x + _attn_out_proj(p["attn"], out, B, Tq)
+        x = x + _mlp(cfg, p["mlp"], _norm(cfg, p["norm2"], x))
+        return (x, K, V), None
 
-    x, (new_k, new_v) = jax.lax.scan(body, x,
-                                     (params["blocks"], cache["k"], cache["v"]))
+    L = cfg.n_layers
+    # full unroll: static per-layer weight slices let XLA prefetch each
+    # layer's weights while the previous layer computes — measured +14%
+    # decode throughput over the rolled loop (r4, GPT2-124M bs8). Decode
+    # bodies are small so even 48-layer graphs compile fine.
+    (x, new_k, new_v), _ = jax.lax.scan(
+        body, (x, cache["k"], cache["v"]),
+        (params["blocks"], jnp.arange(L)), unroll=True)
     x = _norm(cfg, params["final_norm"], x)
     logits = jnp.einsum("btd,dv->btv", x, params["head"]["weight"],
                         preferred_element_type=jnp.float32)

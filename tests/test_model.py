@@ -165,3 +165,47 @@ def test_gpt2_124M_param_count_full_size():
     cfg = get_config_gpt2("124M")
     n = cfg.num_params()
     assert 160e6 < n < 170e6
+
+
+def test_bucketed_generate_greedy_matches_dense_loop(rng_key):
+    """generate() pads the prompt to a shape bucket and resets the cache
+    length to the REAL prompt length — greedy output must equal the naive
+    full-forward re-run per token (reference semantics, generate.py:36-73)
+    for prompt lengths off the bucket boundary."""
+    from building_llm_from_scratch_tpu.generate import generate
+
+    cfg = tiny_llama()
+    params = init_params(cfg, rng_key)
+    for Tp in (5, 9):
+        prompt = np.asarray(jax.random.randint(
+            jax.random.PRNGKey(Tp), (2, Tp), 0, cfg.vocab_size), np.int32)
+        out = generate(params, cfg, prompt, max_new_tokens=7,
+                       context_size=cfg.context_length)
+        ids = prompt.copy()
+        for _ in range(7):
+            logits = forward(params, cfg, jnp.asarray(ids))[:, -1]
+            nxt = np.asarray(jnp.argmax(logits, axis=-1))
+            ids = np.concatenate([ids, nxt[:, None].astype(ids.dtype)], 1)
+        np.testing.assert_array_equal(np.asarray(out), ids)
+
+
+def test_generate_eos_stop_quirk(rng_key):
+    """All-rows-eos stops WITHOUT appending the triggering token
+    (reference generate.py:68-73)."""
+    from building_llm_from_scratch_tpu.generate import generate
+
+    cfg = tiny_llama()
+    params = init_params(cfg, rng_key)
+    # two IDENTICAL rows: greedy emits the same first token on both by
+    # construction, so the all-rows-eos condition is guaranteed to trigger
+    row = np.asarray(jax.random.randint(
+        jax.random.PRNGKey(1), (1, 6), 0, cfg.vocab_size), np.int32)
+    prompt = np.concatenate([row, row], axis=0)
+    probe = generate(params, cfg, prompt, max_new_tokens=1,
+                     context_size=cfg.context_length)
+    first = np.asarray(probe)[:, -1]
+    assert first[0] == first[1]
+    out = generate(params, cfg, prompt, max_new_tokens=5,
+                   context_size=cfg.context_length,
+                   eos_id=int(first[0]))
+    assert out.shape[1] == prompt.shape[1]         # nothing appended
