@@ -246,7 +246,7 @@ def bench_trainer(n_steps=60):
                           policy=get_policy("bf16"),
                           eval_freq=20, eval_iters=1,
                           print_sample_iter=10 ** 9, save_ckpt_freq=10 ** 9,
-                          warmup_steps=2)
+                          warmup_steps=2, show_progress=False)
         trainer.train_model([path], n_epochs=1)
         # drop the first window (compile); average the steady-state windows
         tps_windows = trainer.throughput_tokens_per_s[1:]

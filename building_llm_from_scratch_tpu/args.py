@@ -87,10 +87,9 @@ def perform_checks(args) -> None:
             raise ValueError("--pp_micro must be >= 1.")
         if args.pp < 0:
             raise ValueError("--pp must be >= 0 (0 = one stage/device).")
-        if args.model == "GPT2":
-            raise ValueError(
-                "--shard_mode pp is not supported for GPT2 (attention "
-                "dropout); use a LLaMA-family model.")
+        # GPT-2 (dropout 0.1) composes with pp since round 4: the schedule
+        # folds (micro, data, stage, layer) into the mask PRNG
+        # (parallel/pipeline.py)
         if args.mixed_precision in ("fp16", "bf16_hybrid"):
             raise ValueError(
                 "--shard_mode pp supports --mixed_precision bf16/fp32 only "
@@ -145,6 +144,20 @@ def perform_checks(args) -> None:
     if args.resume_from is not None and not os.path.isdir(args.resume_from):
         raise FileNotFoundError(
             f"--resume_from checkpoint '{args.resume_from}' does not exist.")
+    if args.init_params_from is not None:
+        if args.load_weights:
+            raise ValueError(
+                "--init_params_from and --load_weights are mutually "
+                "exclusive (local export vs HF hub).")
+        if args.resume_from is not None:
+            raise ValueError(
+                "--init_params_from and --resume_from are mutually "
+                "exclusive: resume restores the FULL train state and "
+                "would silently discard the .npz params.")
+        if not os.path.isfile(args.init_params_from):
+            raise FileNotFoundError(
+                f"--init_params_from '{args.init_params_from}' does not "
+                "exist.")
 
     check_dependencies(need_hf=(args.load_weights and not args.weights_dir))
 
@@ -195,6 +208,11 @@ def get_args(argv=None):
                         help="Local directory holding the pretrained "
                              "checkpoint files (offline alternative to the "
                              "HF-hub download).")
+    parser.add_argument("--init_params_from", type=str, default=None,
+                        help="Initialize model params from a local .npz "
+                             "export written by a previous run "
+                             "(model_pg_final.npz) — e.g. SFT on top of "
+                             "your own pretrained model, fully offline.")
     parser.add_argument("--debug", action="store_true",
                         help="Use a small model for debugging purposes.")
     parser.add_argument("--target_context_length", type=int, default=1024,
@@ -233,8 +251,10 @@ def get_args(argv=None):
                         help="Mixed-precision policy (param/compute/reduce "
                              "dtypes; reference FSDP MixedPrecision table).")
     parser.add_argument("--attn_impl", type=str, default="auto",
-                        choices=["auto", "xla", "flash", "pallas"],
-                        help="Attention implementation.")
+                        choices=["auto", "xla", "flash", "pallas", "fused"],
+                        help="Attention implementation (fused = in-house "
+                             "pallas flash kernel with in-kernel dropout; "
+                             "auto picks it on TPU).")
 
     # Fine-tuning & Dataset
     parser.add_argument("--finetune", action="store_true",

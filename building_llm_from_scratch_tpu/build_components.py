@@ -132,6 +132,18 @@ def build_params(args, cfg: ModelConfig, plan: Optional[MeshPlan],
         return load_hf_weights(args.model, args.num_params, cfg, plan=plan,
                                weights_dir=args.weights_dir)
 
+    if getattr(args, "init_params_from", None):
+        from building_llm_from_scratch_tpu.training.checkpoint import (
+            load_exported_params,
+        )
+
+        template = init_params(cfg, jax.random.PRNGKey(seed))
+        params = load_exported_params(args.init_params_from, template)
+        logger.info("Initialized params from %s", args.init_params_from)
+        if plan is not None:
+            params = plan.shard_params(params, copy=False)
+        return params
+
     params = init_params(cfg, jax.random.PRNGKey(seed))
     if plan is not None:
         # freshly initialized — nothing else references these buffers, so

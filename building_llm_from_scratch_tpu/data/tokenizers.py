@@ -36,20 +36,26 @@ class ByteTokenizer:
 
     def encode(self, text: str, allowed_special: Optional[Iterable[str]] = None
                ) -> List[int]:
+        """Bulk UTF-8 encode with allowed specials spliced in.
+
+        Segments on the allowed special tokens with one regex pass and
+        bulk-encodes the text between them — the original per-character
+        Python loop took minutes per MB, which stalled real corpus runs
+        (100MB+ shards) in the step-count pre-pass."""
+        import re
+
         allowed = set(allowed_special or self.specials)
+        pattern = "|".join(re.escape(s) for s in self.specials
+                           if s in allowed)
+        if not pattern:
+            return list(text.encode("utf-8"))
         out: List[int] = []
-        i = 0
-        while i < len(text):
-            matched = False
-            for s, sid in self.specials.items():
-                if s in allowed and text.startswith(s, i):
-                    out.append(sid)
-                    i += len(s)
-                    matched = True
-                    break
-            if not matched:
-                out.extend(text[i].encode("utf-8"))
-                i += 1
+        pos = 0
+        for m in re.finditer(pattern, text):
+            out.extend(text[pos:m.start()].encode("utf-8"))
+            out.append(self.specials[m.group(0)])
+            pos = m.end()
+        out.extend(text[pos:].encode("utf-8"))
         return out
 
     def decode(self, ids: Sequence[int]) -> str:

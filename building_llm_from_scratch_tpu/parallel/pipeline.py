@@ -16,13 +16,27 @@ ppermute), so forward and backward share one implementation and the
 optimizer step stays the ordinary optax update. XLA overlaps each tick's
 hop (ICI neighbor transfer) with the next tick's layer compute.
 
-Scope: deterministic forward only (dropout-free models — same restriction
-as ring attention); embeddings/norm/head are replicated and evaluated where
-needed (stage 0 embeds, the last stage projects). Bubble fraction is
-(S-1)/(M+S-1) — choose M >= S for efficiency. The mesh composes a data
-axis with the stage axis ((data=D, stage=S), D = n_devices/S): each data
-column pipelines its own microbatch rows and the loss/grads psum over both
-axes.
+Embeddings/norm/head are replicated and evaluated where needed (stage 0
+embeds, the last stage projects). Bubble fraction is (S-1)/(M+S-1) —
+choose M >= S for efficiency. The mesh composes a data axis with the stage
+axis ((data=D, stage=S), D = n_devices/S): each data column pipelines its
+own microbatch rows and the loss/grads psum over both axes.
+
+Round-4 (v2) changes, per the r3 VERDICT weakness #4:
+  - ``--use_actv_ckpt`` is honored: remat of the stage body is OPT-IN.
+    With it off, the scan transpose reads saved activations instead of
+    recomputing every stage forward during the backward — the backward
+    tick drops from (fwd+bwd) to bwd work, worth ~1.33x on the training
+    step (bwd ~ 2x fwd). Remat remains the memory-bound choice: saved
+    activations scale with M microbatches in flight.
+  - dropout is supported (GPT-2's configs train with 0.1): each
+    (microbatch, data shard, stage, layer) folds its own PRNG key, so
+    masks are iid across the schedule and bit-stable under the scan
+    transpose / remat replay.
+  - warmup/drain ticks with no valid microbatch for a stage skip their
+    compute via ``lax.cond`` (device-local; the SPMD program stays
+    uniform) — this also removes the stage-0 drain-tick waste flagged by
+    the r3 advisor (pipeline.py ADVICE #4).
 """
 
 from __future__ import annotations
@@ -46,6 +60,12 @@ Params = Dict[str, Any]
 
 STAGE_AXIS = "stage"
 DATA_AXIS = "data"
+
+# Ablation switch for scripts/bench_pp.py ONLY: False reproduces the r3
+# schedule where every stage computed on every tick (stage 0 re-ran its
+# whole stage on drain ticks, warmup stages chewed garbage) so the v2
+# gating win is measurable. Leave True.
+GATE_INVALID_TICKS = True
 
 
 def make_pp_mesh(n_stages: int, devices=None) -> Mesh:
@@ -88,47 +108,89 @@ def stage_shardings(params: Params, mesh: Mesh) -> Params:
 
 def make_pp_loss_fn(cfg: ModelConfig, mesh: Mesh, n_micro: int
                     ) -> Callable:
-    """Build loss_fn(params, batch) -> mean CE, pipelined over the mesh's
-    stage axis. ``params`` uses the normal (L, ...) layout; the stage split
-    happens inside. Differentiable — wrap in jax.value_and_grad."""
+    """Build loss_fn(params, batch, rng) -> mean CE, pipelined over the
+    mesh's stage axis. ``params`` uses the normal (L, ...) layout; the
+    stage split happens inside. Differentiable — wrap in
+    jax.value_and_grad. ``rng=None`` (or drop_rate 0) disables dropout."""
     S = mesh.shape[STAGE_AXIS]
     if cfg.n_layers % S != 0:
         raise ValueError(
             f"n_layers {cfg.n_layers} not divisible by {S} stages")
-    if cfg.drop_rate > 0.0:
-        raise ValueError("pipeline parallelism requires drop_rate=0 "
-                         "(deterministic forward)")
     rope = _rope_tables(cfg)
+    layers_per_stage = cfg.n_layers // S
 
-    def local_stage(blocks_local, x):
-        """Run this stage's L/S layers (scan over the local slice)."""
-        def body(carry, p):
-            y, _ = _block(cfg, p, carry, rope, None, None, None, None, True)
+    def local_stage(blocks_local, x, key):
+        """Run this stage's L/S layers (scan over the local slice).
+        ``key=None`` -> deterministic; else per-layer folded dropout."""
+        deterministic = key is None
+        if key is None:
+            key = jax.random.PRNGKey(0)          # unused, fixed for scan
+
+        def body(carry, xs):
+            p, j = xs
+            r = None if deterministic else jax.random.fold_in(key, j)
+            y, _ = _block(cfg, p, carry, rope, None, None, None, r,
+                          deterministic)
             return y, None
 
-        body = jax.checkpoint(body, prevent_cse=False)
-        x, _ = jax.lax.scan(body, x, blocks_local)
+        if cfg.use_actv_ckpt:
+            # opt-in remat (r3 forced it): trades a recomputed stage
+            # forward in every backward tick for O(1) saved activations
+            body = jax.checkpoint(body, prevent_cse=False)
+        x, _ = jax.lax.scan(body, x,
+                            (blocks_local, jnp.arange(layers_per_stage)))
         return x
 
-    def pp_body(params, stage_blocks, inputs_mb, targets_mb, weights_mb):
+    def pp_body(params, stage_blocks, inputs_mb, targets_mb, weights_mb,
+                rng):
         """Runs INSIDE shard_map. stage_blocks: this stage's (L/S, ...)
         slice (shard_map strips the leading stage axis to size 1; squeezed
-        below). inputs/targets/weights: (M, Bm, T), replicated."""
+        below). inputs/targets/weights: (M, Bm, T), replicated; ``rng``:
+        None, or a replicated key — folded per (micro, data shard, stage)
+        here and per layer in local_stage."""
         s = jax.lax.axis_index(STAGE_AXIS)
         blocks_local = jax.tree_util.tree_map(lambda x: x[0], stage_blocks)
         M = inputs_mb.shape[0]
         Bm, T = inputs_mb.shape[1], inputs_mb.shape[2]
         D = cfg.emb_dim
+        dropout_on = rng is not None and cfg.drop_rate > 0.0
+        if dropout_on:
+            shard_key = jax.random.fold_in(
+                jax.random.fold_in(rng, jax.lax.axis_index(DATA_AXIS)),
+                s)
 
         def tick(carry, t):
             act, nll_sum, w_sum = carry
-            # stage 0 injects microbatch t (zeros once the feed runs dry);
-            # later stages consume the activation ppermuted in last tick
-            feed_idx = jnp.clip(t, 0, M - 1)
-            embedded = _embed(cfg, params, inputs_mb[feed_idx], None, None,
-                              True)
-            act = jnp.where(s == 0, embedded, act)
-            act = local_stage(blocks_local, act)
+            # the microbatch this stage works on at tick t (stage 0 feeds
+            # micro t; stage s received micro t-s via last tick's hop)
+            micro = t - s
+            valid = (micro >= 0) & (micro < M)
+            m_idx = jnp.clip(micro, 0, M - 1)
+            if dropout_on:
+                mb_key = jax.random.fold_in(shard_key, m_idx)
+                emb_key = jax.random.fold_in(mb_key, 10_000)
+            else:
+                mb_key = emb_key = None
+
+            def run(act):
+                # stage 0 replaces the carried activation with the fresh
+                # embedding of its feed microbatch; the embed runs INSIDE
+                # the device-local cond so stages 1..S-1 never compute it
+                def feed(a):
+                    return _embed(cfg, params, inputs_mb[m_idx], None,
+                                  emb_key if dropout_on else None,
+                                  not dropout_on).astype(a.dtype)
+
+                a = jax.lax.cond(s == 0, feed, lambda a: a, act)
+                return local_stage(blocks_local, a, mb_key)
+
+            # warmup/drain ticks with no valid micro skip ALL compute
+            # (device-local cond — r3 burned a full stage forward per
+            # drain tick on stage 0, ADVICE #4)
+            if GATE_INVALID_TICKS:
+                act = jax.lax.cond(valid, run, lambda a: a, act)
+            else:                      # r3-equivalent ablation (bench only)
+                act = run(act)
 
             # last stage: microbatch (t - (S-1)) completes on tick t. The
             # V-sized head projection is the most expensive matmul in the
@@ -177,7 +239,8 @@ def make_pp_loss_fn(cfg: ModelConfig, mesh: Mesh, n_micro: int
         w_sum = jax.lax.psum(w_sum, (STAGE_AXIS, DATA_AXIS))
         return nll_sum / jnp.maximum(w_sum, 1.0)
 
-    def loss_fn(params: Params, batch: Dict[str, jnp.ndarray]) -> jnp.ndarray:
+    def loss_fn(params: Params, batch: Dict[str, jnp.ndarray],
+                rng: Optional[jax.Array] = None) -> jnp.ndarray:
         B, T = batch["inputs"].shape
         D_data = mesh.shape[DATA_AXIS]
         if B % n_micro != 0:
@@ -199,8 +262,18 @@ def make_pp_loss_fn(cfg: ModelConfig, mesh: Mesh, n_micro: int
 
         rep = P()
         mb_spec = P(None, DATA_AXIS)   # each data column pipelines its rows
+        if rng is not None and cfg.drop_rate > 0.0:
+            fn = jax.shard_map(
+                pp_body,
+                mesh=mesh,
+                in_specs=(rep, P(STAGE_AXIS), mb_spec, mb_spec, mb_spec,
+                          rep),
+                out_specs=rep,
+                check_vma=False,
+            )
+            return fn(other, stage_blocks, inputs, targets, weights, rng)
         fn = jax.shard_map(
-            pp_body,
+            lambda p, b, i, t, w: pp_body(p, b, i, t, w, None),
             mesh=mesh,
             in_specs=(rep, P(STAGE_AXIS), mb_spec, mb_spec, mb_spec),
             out_specs=rep,
@@ -294,8 +367,12 @@ def make_pp_train_step(cfg: ModelConfig, optimizer, mesh: Mesh, *,
     loss_fn = make_pp_loss_fn(cfg, mesh, n_micro)
 
     def train_step(state, batch):
+        step_rng = (jax.random.fold_in(state["rng"], state["step"])
+                    if cfg.drop_rate > 0.0 else None)
+
         def loss_of(trainable):
-            return loss_fn(full_params(trainable, state["frozen"]), batch)
+            return loss_fn(full_params(trainable, state["frozen"]), batch,
+                           step_rng)
 
         loss, grads = jax.value_and_grad(loss_of)(state["trainable"])
         return _finish_step(state, loss, grads, batch["inputs"].size,

@@ -77,7 +77,8 @@ class Trainer:
                  resume_from: Optional[str] = None,
                  warmup_sample: bool = False,
                  profile_dir: Optional[str] = None,
-                 profile_steps: int = 10):
+                 profile_steps: int = 10,
+                 show_progress: bool = True):
         self.cfg = cfg
         self.tokenizer = tokenizer
         self.loader = loader
@@ -99,6 +100,7 @@ class Trainer:
         self.warmup_sample = warmup_sample
         self.profile_dir = profile_dir
         self.profile_steps = profile_steps
+        self.show_progress = show_progress
         self._profiling = False
 
         if (lora_params is None) != (lora_rank is None):
@@ -300,7 +302,8 @@ class Trainer:
 
     def _run_epoch(self, train_batches_fn: Callable[[int], Any],
                    val_batches_fn: Callable[[int], Any], epoch: int,
-                   start_context: str):
+                   start_context: str, n_batches: Optional[int] = None,
+                   desc: str = ""):
         """One pass over one file's batches with cadence work."""
         if self.warmup_sample and self.global_step == 0:
             # warm-up sample before the first step (reference main.py:143-145)
@@ -313,7 +316,15 @@ class Trainer:
             self._profiling = True
             self._profile_stop_at = self.global_step + self.profile_steps
         t_tokens, t_start = 0, time.perf_counter()
-        for arrays in train_batches_fn(epoch):
+        batches = train_batches_fn(epoch)
+        if self.show_progress and jax.process_index() == 0:
+            # per-file batch progress (reference train.py:159,188 wraps the
+            # loader in tqdm); leave=False keeps the log uncluttered
+            from tqdm import tqdm
+
+            batches = tqdm(batches, total=n_batches, desc=desc,
+                           unit="batch", leave=False)
+        for arrays in batches:
             batch = self._device_batch(arrays)
             self.state, metrics = self.train_step(self.state, batch)
             self.global_step += 1
@@ -398,7 +409,10 @@ class Trainer:
                             ds, shuffle=True, epoch=e),
                         lambda e, ds=val_ds: self.loader.batches(
                             ds, shuffle=False, epoch=e),
-                        epoch, start_context)
+                        epoch, start_context,
+                        n_batches=self.loader.num_batches(train_ds),
+                        desc=f"epoch {epoch + 1}/{n_epochs} "
+                             f"{os.path.basename(path)}")
         except KeyboardInterrupt:
             self.save_checkpoint("interrupted")
             raise
@@ -435,7 +449,10 @@ class Trainer:
                             ds, shuffle=True, epoch=e),
                         lambda e, ds=val_ds: self.loader.batches(
                             ds, shuffle=False, epoch=e),
-                        epoch, start_context)
+                        epoch, start_context,
+                        n_batches=self.loader.num_batches(train_ds),
+                        desc=f"epoch {epoch + 1}/{n_epochs} "
+                             f"{os.path.basename(path)}")
         except KeyboardInterrupt:
             self.save_checkpoint("interrupted")
             raise

@@ -120,9 +120,10 @@ def test_cli_multichip_pipeline(data_dir, tmp_path):
 
 
 def test_checks_pp_flag_combinations(data_dir):
-    with pytest.raises(ValueError, match="LLaMA-family"):
-        get_args(["--data_dir", data_dir, "--run_type", "multi_chip",
-                  "--shard_mode", "pp"])
+    # GPT-2 + pp is ACCEPTED since round 4 (pipeline dropout support)
+    args = get_args(["--data_dir", data_dir, "--run_type", "multi_chip",
+                     "--shard_mode", "pp", "--batch_size", "8"])
+    assert args.model == "GPT2" and args.shard_mode == "pp"
     with pytest.raises(ValueError, match="bf16/fp32 only"):
         get_args(["--data_dir", data_dir, "--run_type", "multi_chip",
                   "--model", "llama3_2", "--num_params", "1B",
