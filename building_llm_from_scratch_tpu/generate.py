@@ -31,6 +31,7 @@ from building_llm_from_scratch_tpu.models.transformer import (
     forward,
     forward_with_cache,
     init_cache,
+    unstack_blocks,
 )
 
 
@@ -80,8 +81,12 @@ def _generate_cached(params, cfg: ModelConfig, prompt: jnp.ndarray,
     """
     B, Tpb = prompt.shape
     cache = init_cache(cfg, B, Tpb + budget)
+    # per-layer weight slices hoisted OUT of the sampling loop (see
+    # unstack_blocks: in-loop slicing re-laid-out weights every token)
+    blocks_list = unstack_blocks(params, cfg)
 
-    logits, cache = forward_with_cache(params, cfg, prompt, cache)
+    logits, cache = forward_with_cache(params, cfg, prompt, cache,
+                                       blocks_list)
     # real prompt occupies [0, prompt_len); pad slots hold garbage k/v that
     # decode overwrites (and kv_length masks meanwhile)
     cache = dict(cache, length=prompt_len)
@@ -113,7 +118,7 @@ def _generate_cached(params, cfg: ModelConfig, prompt: jnp.ndarray,
                 b.dtype), (0, prompt_len + i)),
             buf)
         new_logits, cache = forward_with_cache(
-            params, cfg, nxt[:, None].astype(jnp.int32), cache)
+            params, cfg, nxt[:, None].astype(jnp.int32), cache, blocks_list)
         return (buf, cache, new_logits[:, -1], rng, i + 1, all_eos)
 
     carry = (buf, cache, last, rng, jnp.zeros((), jnp.int32),

@@ -49,9 +49,13 @@ from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from building_llm_from_scratch_tpu.configs import ModelConfig
-from building_llm_from_scratch_tpu.ops.attention import causal_attention
+from building_llm_from_scratch_tpu.ops.attention import (
+    causal_attention,
+    decode_attention,
+)
 from building_llm_from_scratch_tpu.ops.activations import gelu, silu
 from building_llm_from_scratch_tpu.ops.norms import layernorm, rmsnorm
 from building_llm_from_scratch_tpu.ops.rope import (
@@ -138,12 +142,13 @@ def _norm(cfg: ModelConfig, p: Params, x: jnp.ndarray) -> jnp.ndarray:
 def _mlp(cfg: ModelConfig, p: Params, x: jnp.ndarray) -> jnp.ndarray:
     if cfg.activation == "swiglu":
         # silu(gate(x)) * up(x) -> down   (reference common_components.py:95-124)
-        g = x @ p["gate"]
-        u = x @ p["up"]
+        g = checkpoint_name(x @ p["gate"], "gate_out")
+        u = checkpoint_name(x @ p["up"], "up_out")
         return (silu(g) * u) @ p["down"]
     h = x @ p["up"]
     if "b_up" in p:
         h = h + p["b_up"]
+    h = checkpoint_name(h, "up_out")
     h = gelu(h)
     h = h @ p["down"]
     if "b_down" in p:
@@ -210,6 +215,11 @@ def _qkv_proj(cfg: ModelConfig, p: Params, x: jnp.ndarray,
         cos, sin = rope
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
+    # names for the selective-save remat policy (forward_hidden): post-RoPE
+    # q/k/v are saved so the backward neither re-projects nor re-rotates
+    q = checkpoint_name(q, "q")
+    k = checkpoint_name(k, "k")
+    v = checkpoint_name(v, "v")
     return q, k, v
 
 
@@ -289,6 +299,7 @@ def _attention(cfg: ModelConfig, p: Params, x: jnp.ndarray,
             deterministic=deterministic,
             impl=cfg.attn_impl,
         )
+    out = checkpoint_name(out, "attn_out")
     out = _attn_out_proj(p, out, B, Tq)
     return out, new_cache
 
@@ -306,6 +317,7 @@ def _block(cfg: ModelConfig, p: Params, x: jnp.ndarray,
                               r_attn, deterministic, sp_mesh=sp_mesh,
                               sp_inside=sp_inside)
     x = _residual_dropout(x, h, cfg.drop_rate, r_res1, deterministic)
+    x = checkpoint_name(x, "resid_mid")
     h = _mlp(cfg, p["mlp"], _norm(cfg, p["norm2"], x))
     x = _residual_dropout(x, h, cfg.drop_rate, r_res2, deterministic)
     return x, new_cache
@@ -314,6 +326,29 @@ def _block(cfg: ModelConfig, p: Params, x: jnp.ndarray,
 # ---------------------------------------------------------------------------
 # Forward passes
 # ---------------------------------------------------------------------------
+
+def _train_scan_unroll(cfg: ModelConfig) -> int:
+    """Unroll factor for the training layer scan.
+
+    Full unroll on TPU for models up to 24 layers: the rolled scan forces
+    XLA to serialize each layer's weight fetches and residual-save DUS
+    against the loop step, and the backward copies whole stacked (L,.,.)
+    gradient accumulators every iteration (r5 profile: ~8ms/step of pure
+    copies on GPT2-124M bs8). Unrolled, weights prefetch across layers and
+    grad accumulation becomes static-offset updates: measured 82.9k ->
+    97.5k tok/s/chip (+18%) on the bs8 headline, +2.4% on the rematted
+    LLaMA3.2-1B LoRA config. Deeper models keep the O(1)-compile scan
+    (compile time for 36+ unrolled big-layer graphs grows superlinearly);
+    CPU (test) backend always scans. Override: BLLM_TRAIN_UNROLL=<n>."""
+    import os
+
+    env = os.environ.get("BLLM_TRAIN_UNROLL")
+    if env:
+        return int(env)
+    if jax.default_backend() == "tpu" and cfg.n_layers <= 24:
+        return cfg.n_layers
+    return 1
+
 
 def _rope_tables(cfg: ModelConfig):
     if not cfg.uses_rope:
@@ -376,8 +411,25 @@ def forward_hidden(params: Params, cfg: ModelConfig, tokens: jnp.ndarray, *,
 
     if cfg.use_actv_ckpt:
         body = jax.checkpoint(body, prevent_cse=False)
+    else:
+        # Selective-save remat (round-5 profile-driven): under plain
+        # autodiff XLA saved ~460MB/layer of residuals across the scan
+        # (six f32[B,T,D] norm intermediates, four bf16[B,T,4D] MLP
+        # temps, q/k/v...) — ~5.5GB written fwd + re-read bwd per
+        # GPT2-124M bs8 step. Save ONLY the named tensors (post-RoPE
+        # q/k/v, the attention kernel's out+lse, the mid-block residual,
+        # the MLP up/gate outputs) and recompute the cheap elementwise
+        # chains (norms, GELU/SiLU, residual adds) in the backward: no
+        # matmul and no attention-kernel recompute, ~4x less scan-carried
+        # HBM traffic.
+        body = jax.checkpoint(
+            body, prevent_cse=False,
+            policy=jax.checkpoint_policies.save_only_these_names(
+                "q", "k", "v", "attn_raw_out", "attn_lse", "attn_out",
+                "resid_mid", "up_out", "gate_out"))
 
-    x, _ = jax.lax.scan(body, x, (params["blocks"], layer_rngs))
+    x, _ = jax.lax.scan(body, x, (params["blocks"], layer_rngs),
+                        unroll=_train_scan_unroll(cfg))
     return _norm(cfg, params["final_norm"], x)
 
 
@@ -408,27 +460,57 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray, *,
 # ---------------------------------------------------------------------------
 
 def init_cache(cfg: ModelConfig, batch_size: int, max_length: int) -> Params:
-    """Allocate a static-shape KV cache: (L, B, Tmax, Hkv, hd) per k/v."""
-    shape = (cfg.n_layers, batch_size, max_length, cfg.n_kv_groups, cfg.head_dim)
+    """Allocate a static-shape KV cache: a LIST of per-layer (B, Tmax,
+    Hkv, hd) buffers per k/v.
+
+    Per-layer buffers instead of one stacked (L, ...) array (round 5): with
+    the stacked cache as a while-loop carry, XLA failed to alias the
+    dynamic-update-slice writes and copied the ENTIRE cache twice per
+    decoded token (r5 profile: 206us of a 1010us step on GPT2-124M bs8
+    Tmax=320 — copy-start/copy-done pairs over the full 47MB). With one
+    buffer per layer, each layer's update aliases its own small buffer and
+    the other L-1 pass through the carry untouched.
+
+    Layout (B, Hkv, Tmax, hd) — attention-native: ``decode_attention``
+    batches its einsums over (B, H), so the cache streams without the
+    full-buffer re-layout copies the (B, T, H, D) model layout forced
+    through ``causal_attention`` (the r5 profile's other 24
+    copies/step).
+    """
+    shape = (batch_size, cfg.n_kv_groups, max_length, cfg.head_dim)
     return {
-        "k": jnp.zeros(shape, cfg.jax_dtype),
-        "v": jnp.zeros(shape, cfg.jax_dtype),
+        "k": [jnp.zeros(shape, cfg.jax_dtype) for _ in range(cfg.n_layers)],
+        "v": [jnp.zeros(shape, cfg.jax_dtype) for _ in range(cfg.n_layers)],
         "length": jnp.zeros((), jnp.int32),
     }
 
 
+def unstack_blocks(params: Params, cfg: ModelConfig) -> list:
+    """Split the stacked (L, ...) block params into a list of per-layer
+    trees. The decode loop wants this done ONCE outside the sampling
+    while-loop: slicing stacked weights inside the loop made XLA re-layout
+    wq/wk/wv copies every decoded token (r5 profile: 123us/step of
+    loop-invariant weight transposes)."""
+    return [
+        jax.tree_util.tree_map(lambda a, l=l: a[l], params["blocks"])
+        for l in range(cfg.n_layers)
+    ]
+
+
 def forward_with_cache(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
-                       cache: Params) -> Tuple[jnp.ndarray, Params]:
+                       cache: Params,
+                       blocks_list: Optional[list] = None
+                       ) -> Tuple[jnp.ndarray, Params]:
     """Decode forward: process ``tokens`` (B, Tq) given ``cache`` holding
     ``cache['length']`` valid positions; returns (fp32 logits (B, Tq, V),
     updated cache). Static shapes throughout — jit-friendly.
 
-    The full stacked (L, B, Tmax, Hkv, hd) k/v buffers travel through the
-    layer scan as CARRY (each layer dynamic-update-slices its row in
-    place). The previous design scanned per-layer cache slices as xs and
-    restacked them as ys, which made XLA materialize gather+stack copies of
-    the entire cache every token — measured 0.39 ms/step of pure copies on
-    the GPT2-124M decode profile (r4).
+    The layer loop is a plain Python loop (decode bodies are small; the
+    r4 scan-unroll measured +14% over the rolled loop, and the explicit
+    loop additionally lets per-layer cache buffers alias — see
+    ``init_cache``). Pass ``blocks_list`` (from ``unstack_blocks``) when
+    calling inside a sampling loop so the per-layer weight slices are
+    hoisted out of it.
 
     Contract: the caller must ensure ``cache['length'] + Tq <= max_length``
     (the cache allocation). Under jit an overflow cannot raise —
@@ -443,32 +525,56 @@ def forward_with_cache(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
 
     x = _embed(cfg, params, tokens, positions, None, True)
 
-    def body(carry, layer):
-        x, K, V = carry
-        p, l = layer
+    if blocks_list is None:
+        blocks_list = unstack_blocks(params, cfg)
+
+    import os as _os
+
+    # BLLM_FUSED_DECODE=1 opts into the pallas fused append+attend kernel
+    # (ops/decode_step.py). It provably removes the per-token whole-cache
+    # copies XLA inserts on the while-loop carry, but measured 3% SLOWER
+    # end-to-end on GPT2-124M bs8 (690 vs 715 tok/s/seq, r5 A/B x3): its
+    # per-batch-row grid serializes attention panes the XLA path overlaps
+    # with the surrounding weight streams. Kept for GQA shapes / future
+    # tuning; default off.
+    use_fused_step = False
+    if (jax.default_backend() == "tpu"
+            and _os.environ.get("BLLM_FUSED_DECODE", "0") == "1"):
+        from building_llm_from_scratch_tpu.ops.decode_step import (
+            supports_shape as _fds_supports,
+        )
+
+        Tmax = cache["k"][0].shape[2]
+        use_fused_step = _fds_supports(Tq, Tmax, cfg.head_dim)
+
+    new_k, new_v = [], []
+    for p, K, V in zip(blocks_list, cache["k"], cache["v"]):
         h = _norm(cfg, p["norm1"], x)
         q, k, v = _qkv_proj(cfg, p["attn"], h, rope, positions)
-        K = jax.lax.dynamic_update_slice(K, k[None].astype(K.dtype),
-                                         (l, 0, length, 0, 0))
-        V = jax.lax.dynamic_update_slice(V, v[None].astype(V.dtype),
-                                         (l, 0, length, 0, 0))
-        kf = jax.lax.dynamic_index_in_dim(K, l, 0, keepdims=False)
-        vf = jax.lax.dynamic_index_in_dim(V, l, 0, keepdims=False)
-        out = causal_attention(q, kf, vf, q_positions=positions,
-                               kv_length=length + Tq,
-                               impl=cfg.attn_impl)
+        if use_fused_step:
+            # fused in-place append + attention (ops/decode_step.py): the
+            # pallas input_output_aliases declaration is what finally stops
+            # XLA from copying the whole cache every token (r5 profiles)
+            from building_llm_from_scratch_tpu.ops.decode_step import (
+                fused_decode_step,
+            )
+
+            out, K, V = fused_decode_step(q, k.astype(K.dtype),
+                                          v.astype(V.dtype), K, V, length)
+        else:
+            # (B, Tq, Hkv, hd) -> cache-native (B, Hkv, Tq, hd) — tiny
+            K = jax.lax.dynamic_update_slice(
+                K, k.transpose(0, 2, 1, 3).astype(K.dtype),
+                (0, 0, length, 0))
+            V = jax.lax.dynamic_update_slice(
+                V, v.transpose(0, 2, 1, 3).astype(V.dtype),
+                (0, 0, length, 0))
+            out = decode_attention(q, K, V, q_positions=positions,
+                                   kv_length=length + Tq)
+        new_k.append(K)
+        new_v.append(V)
         x = x + _attn_out_proj(p["attn"], out, B, Tq)
         x = x + _mlp(cfg, p["mlp"], _norm(cfg, p["norm2"], x))
-        return (x, K, V), None
-
-    L = cfg.n_layers
-    # full unroll: static per-layer weight slices let XLA prefetch each
-    # layer's weights while the previous layer computes — measured +14%
-    # decode throughput over the rolled loop (r4, GPT2-124M bs8). Decode
-    # bodies are small so even 48-layer graphs compile fine.
-    (x, new_k, new_v), _ = jax.lax.scan(
-        body, (x, cache["k"], cache["v"]),
-        (params["blocks"], jnp.arange(L)), unroll=True)
     x = _norm(cfg, params["final_norm"], x)
     logits = jnp.einsum("btd,dv->btv", x, params["head"]["weight"],
                         preferred_element_type=jnp.float32)

@@ -245,6 +245,48 @@ def _pallas_flash_attention(q, k, v, block: int = 512):
 
 
 # ---------------------------------------------------------------------------
+# decode path: cache-layout-native attention
+# ---------------------------------------------------------------------------
+
+def decode_attention(
+    q: jnp.ndarray,               # (B, Tq, Hq, D) — model layout (tiny Tq)
+    k_cache: jnp.ndarray,         # (B, Hkv, Tmax, D) — cache-native layout
+    v_cache: jnp.ndarray,         # (B, Hkv, Tmax, D)
+    *,
+    q_positions: jnp.ndarray,     # (Tq,) absolute positions
+    kv_length: jnp.ndarray,       # scalar: valid cache prefix
+) -> jnp.ndarray:
+    """Attention for KV-cache decode, consuming the cache in its OWN
+    (B, H, T, D) layout.
+
+    The general ``causal_attention`` takes (B, T, H, D) k/v; feeding it the
+    cache made XLA materialize a transposed copy of the ENTIRE cache for
+    every layer of every decoded token (r5 profile: ~24 full-buffer
+    copies/step, ~40% of decode step time on GPT2-124M bs8). Here the
+    score/value einsums batch over (B, H) directly, so the cache streams
+    without re-layout. Exact same math/masking as the xla path with
+    ``q_positions``/``kv_length``; no dropout (decode is eval-only).
+    """
+    B, Tq, Hq, D = q.shape
+    _, Hkv, Tkv, _ = k_cache.shape
+    G = Hq // Hkv
+    scale = 1.0 / jnp.sqrt(jnp.asarray(D, dtype=jnp.float32))
+    # (B, Hkv, G, Tq, D) — tiny transpose (Tq is 1 for decode steps)
+    qg = q.reshape(B, Tq, Hkv, G, D).transpose(0, 2, 3, 1, 4)
+    scores = jnp.einsum("bhgqd,bhkd->bhgqk", qg, k_cache,
+                        preferred_element_type=jnp.float32) * scale
+    kv_pos = jnp.arange(Tkv)
+    mask = (q_positions[:, None] >= kv_pos[None, :]) \
+        & (kv_pos[None, :] < kv_length)
+    scores = jnp.where(mask[None, None, None], scores,
+                       jnp.asarray(_NEG_INF, scores.dtype))
+    weights = jax.nn.softmax(scores, axis=-1).astype(v_cache.dtype)
+    out = jnp.einsum("bhgqk,bhkd->bhgqd", weights, v_cache)
+    # (B, Hkv, G, Tq, D) -> (B, Tq, Hq, D)
+    return out.transpose(0, 3, 1, 2, 4).reshape(B, Tq, Hq, D)
+
+
+# ---------------------------------------------------------------------------
 # public entry
 # ---------------------------------------------------------------------------
 

@@ -306,8 +306,14 @@ def _fused_bhtd(q, k, v, seed, rate, bq, bk):
 
 
 def _fused_fwd_rule(q, k, v, seed, rate, bq, bk):
+    from jax.ad_checkpoint import checkpoint_name
+
     scale = 1.0 / float(q.shape[-1]) ** 0.5
     out, lse = _fwd(q, k, v, seed, scale=scale, rate=rate, bq=bq, bk=bk)
+    # named so the transformer's selective-save remat policy stores these
+    # residuals instead of re-running the forward kernel in the backward
+    out = checkpoint_name(out, "attn_raw_out")
+    lse = checkpoint_name(lse, "attn_lse")
     return out, (q, k, v, seed, out, lse)
 
 
@@ -329,8 +335,8 @@ def fused_causal_attention(
     *,
     dropout_rate: float = 0.0,
     dropout_rng: jax.Array | None = None,
-    block_q: int = 512,
-    block_k: int = 512,
+    block_q: int | None = None,
+    block_k: int | None = None,
 ) -> jnp.ndarray:
     """Fused causal flash attention, optional in-kernel attention dropout.
 
@@ -338,6 +344,12 @@ def fused_causal_attention(
     ops/attention.py guarantees it; explicit callers must check
     ``supports_shape``).
     """
+    import os
+
+    if block_q is None:
+        block_q = int(os.environ.get("BLLM_ATTN_BQ", "512"))
+    if block_k is None:
+        block_k = int(os.environ.get("BLLM_ATTN_BK", "512"))
     B, T, Hq, D = q.shape
     if k.shape[1] != T or v.shape[1] != T:
         raise ValueError(

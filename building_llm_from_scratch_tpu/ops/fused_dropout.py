@@ -53,13 +53,22 @@ def _bwd_kernel(seed_ref, g_ref, dh_ref, *, rate):
 
 
 _ROWS = 512
+# below this row-block size the grid degenerates toward one PRNG reseed per
+# handful of rows (worst case N prime: N single-row tiles) — the XLA path
+# wins there (round-4 ADVICE low #3)
+_MIN_ROWS = 8
+
+
+def _best_rows(n: int) -> int:
+    r = min(_ROWS, n)
+    while n % r:
+        r -= 1
+    return r
 
 
 def _tiles(h):
     n, d = h.shape
-    r = min(_ROWS, n)
-    while n % r:
-        r -= 1
+    r = _best_rows(n)
     return n // r, r
 
 
@@ -131,8 +140,15 @@ _dropout2d.defvjp(_d_fwd, _d_bwd)
 
 
 def supports_shape(shape) -> bool:
-    """Last dim lane-aligned and leading dims foldable."""
-    return len(shape) >= 2 and shape[-1] % 128 == 0
+    """Last dim lane-aligned AND the folded leading dims admit a row block
+    of at least ``_MIN_ROWS`` (otherwise the pallas grid degenerates into
+    per-row tiles that each reseed the PRNG — slower than XLA dropout)."""
+    if len(shape) < 2 or shape[-1] % 128 != 0:
+        return False
+    n = 1
+    for d in shape[:-1]:
+        n *= int(d)
+    return _best_rows(n) >= _MIN_ROWS
 
 
 def _seed_from_rng(rng):

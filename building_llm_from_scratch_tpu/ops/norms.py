@@ -6,33 +6,116 @@ hand-written fp32 RMSNorm in Models/Llama/common_components.py:54-70.
 Both are computed in fp32 regardless of the activation dtype (matching the
 reference's RMSNorm, and torch LayerNorm's internal accumulation) and cast
 back to the input dtype, which keeps bf16 training stable on TPU.
+
+Custom VJP (round 5): under plain autodiff XLA saved the fp32 normalized
+intermediates of every norm for the backward — on the GPT2-124M bs8 profile
+that is multiple f32[L,B,T,D] residual buffers carried across the layer
+scan (~300MB each, written in the forward and re-read in the backward).
+The custom rule saves only the compute-dtype input plus the per-row fp32
+stats (mean/rstd — (B,T,1)) and recomputes x-hat in the backward: same
+math, ~2x less norm-related HBM traffic.
 """
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
+
+
+# ---------------------------------------------------------------------------
+# layernorm
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _layernorm(x, scale, bias, eps):
+    y, _, _ = _ln_fwd_math(x, scale, bias, eps)
+    return y
+
+
+def _ln_fwd_math(x, scale, bias, eps):
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    rstd = jnp.reciprocal(jnp.sqrt(var + eps))
+    y = (x32 - mean) * rstd * scale.astype(jnp.float32)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    return y.astype(x.dtype), mean, rstd
+
+
+def _ln_fwd(x, scale, bias, eps):
+    y, mean, rstd = _ln_fwd_math(x, scale, bias, eps)
+    # residuals: compute-dtype x + tiny fp32 row stats — NOT the fp32 x-hat
+    return y, (x, scale, bias, mean, rstd)
+
+
+def _ln_bwd(eps, res, g):
+    x, scale, bias, mean, rstd = res
+    x32 = x.astype(jnp.float32)
+    g32 = g.astype(jnp.float32)
+    xhat = (x32 - mean) * rstd
+    axes = tuple(range(x.ndim - 1))
+    dscale = jnp.sum(g32 * xhat, axis=axes).astype(scale.dtype)
+    dbias = (jnp.sum(g32, axis=axes).astype(bias.dtype)
+             if bias is not None else None)
+    u = g32 * scale.astype(jnp.float32)
+    # dx = r * (u - mean(u) - xhat * mean(u * xhat))
+    dx = rstd * (u - jnp.mean(u, axis=-1, keepdims=True)
+                 - xhat * jnp.mean(u * xhat, axis=-1, keepdims=True))
+    return dx.astype(x.dtype), dscale, dbias
+
+
+_layernorm.defvjp(_ln_fwd, _ln_bwd)
 
 
 def layernorm(x: jnp.ndarray, scale: jnp.ndarray,
               bias: Optional[jnp.ndarray] = None,
               eps: float = 1e-5) -> jnp.ndarray:
-    dtype = x.dtype
+    return _layernorm(x, scale, bias, float(eps))
+
+
+# ---------------------------------------------------------------------------
+# rmsnorm
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _rmsnorm(x, scale, eps):
+    y, _ = _rms_fwd_math(x, scale, eps)
+    return y
+
+
+def _rms_fwd_math(x, scale, eps):
     x32 = x.astype(jnp.float32)
-    mean = jnp.mean(x32, axis=-1, keepdims=True)
-    var = jnp.var(x32, axis=-1, keepdims=True)
-    y = (x32 - mean) * jnp.reciprocal(jnp.sqrt(var + eps))
-    y = y * scale.astype(jnp.float32)
-    if bias is not None:
-        y = y + bias.astype(jnp.float32)
-    return y.astype(dtype)
+    ms = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    rstd = jnp.reciprocal(jnp.sqrt(ms + eps))
+    y = x32 * rstd * scale.astype(jnp.float32)
+    return y.astype(x.dtype), rstd
+
+
+def _rms_fwd(x, scale, eps):
+    y, rstd = _rms_fwd_math(x, scale, eps)
+    return y, (x, scale, rstd)
+
+
+def _rms_bwd(eps, res, g):
+    x, scale, rstd = res
+    x32 = x.astype(jnp.float32)
+    g32 = g.astype(jnp.float32)
+    xhat = x32 * rstd
+    axes = tuple(range(x.ndim - 1))
+    dscale = jnp.sum(g32 * xhat, axis=axes).astype(scale.dtype)
+    u = g32 * scale.astype(jnp.float32)
+    # dx = r * (u - xhat * mean(u * xhat))
+    dx = rstd * (u - xhat * jnp.mean(u * xhat, axis=-1, keepdims=True))
+    return dx.astype(x.dtype), dscale
+
+
+_rmsnorm.defvjp(_rms_fwd, _rms_bwd)
 
 
 def rmsnorm(x: jnp.ndarray, scale: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
     """Root-mean-square norm (reference common_components.py:54-70)."""
-    dtype = x.dtype
-    x32 = x.astype(jnp.float32)
-    ms = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
-    y = x32 * jnp.reciprocal(jnp.sqrt(ms + eps)) * scale.astype(jnp.float32)
-    return y.astype(dtype)
+    return _rmsnorm(x, scale, float(eps))

@@ -132,14 +132,22 @@ def _xent_bwd(chunk, res, g):
 softmax_xent.defvjp(_xent_fwd, _xent_bwd)
 
 
+def _default_chunk() -> int:
+    import os
+
+    return int(os.environ.get("BLLM_XENT_CHUNK", "51200"))
+
+
 def fused_cross_entropy_loss(hidden: jnp.ndarray,      # (B, T, D)
                              w_head: jnp.ndarray,      # (D, V)
                              targets: jnp.ndarray,     # (B, T)
                              weights: Optional[jnp.ndarray] = None,
-                             chunk: int = 51200) -> jnp.ndarray:
+                             chunk: Optional[int] = None) -> jnp.ndarray:
     """Weighted token-mean CE — same semantics as
     training.train_step.cross_entropy_loss(forward(...), targets, weights)
     without ever materializing (B, T, V) fp32 logits."""
+    if chunk is None:
+        chunk = _default_chunk()
     B, T, D = hidden.shape
     nll = softmax_xent(hidden.reshape(B * T, D), w_head,
                        targets.reshape(B * T).astype(jnp.int32), chunk)
@@ -151,9 +159,11 @@ def fused_cross_entropy_loss(hidden: jnp.ndarray,      # (B, T, D)
 
 
 def fused_cross_entropy_sums(hidden, w_head, targets, weights,
-                             chunk: int = 51200):
+                             chunk: Optional[int] = None):
     """(weighted nll sum, weight sum) — the cross-shard-psum variant
     (mirrors train_step.cross_entropy_sums)."""
+    if chunk is None:
+        chunk = _default_chunk()
     B, T, D = hidden.shape
     nll = softmax_xent(hidden.reshape(B * T, D), w_head,
                        targets.reshape(B * T).astype(jnp.int32), chunk)

@@ -225,6 +225,18 @@ class MeshPlan:
         (see ``place_state_donation_safe``)."""
         return place_state_donation_safe(state, self.state_shardings(state))
 
+    def param_spec_tree(self, params: Params, root: str = "trainable"
+                        ) -> Params:
+        """Raw ``PartitionSpec`` tree for a params pytree (shard_map
+        in/out_specs want plain specs, not NamedShardings)."""
+        del root  # param_spec rules don't depend on trainable vs frozen
+
+        def spec_of(path, leaf):
+            return self.param_spec(_path_names(path),
+                                   tuple(getattr(leaf, "shape", ())))
+
+        return jax.tree_util.tree_map_with_path(spec_of, params)
+
     def params_shardings(self, params: Params) -> Params:
         def spec_of(path, leaf):
             return self._named(self.param_spec(

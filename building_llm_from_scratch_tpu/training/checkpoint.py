@@ -94,6 +94,13 @@ def _unique_shards(leaf):
     return out
 
 
+def _barrier(name: str) -> None:
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)
+
+
 def save_checkpoint(ckpt_dir: str, state: Params,
                     extra_metadata: Optional[dict] = None) -> str:
     """Write ``state`` as a SHARDED checkpoint. Returns the dir.
@@ -102,24 +109,59 @@ def save_checkpoint(ckpt_dir: str, state: Params,
     replica wins, so replicated leaves are written exactly once across the
     job); process 0 writes the manifest. Nothing is gathered — peak host
     memory is one shard. All processes must see the same filesystem.
+
+    Atomic commit (round-4 ADVICE medium #1): all shards land in a
+    ``<dir>.tmp`` staging dir; after a cross-process barrier confirms every
+    shard write finished, process 0 writes the manifest (still into the
+    staging dir) and renames it over the target. A reader therefore never
+    sees a manifest without all its shards. The commit is two renames
+    (previous -> ``.old``, staging -> final); a preemption in the window
+    between them leaves no dir at the tag itself, but BOTH neighbours are
+    complete (``.tmp`` holds the new checkpoint incl. manifest, ``.old``
+    the previous one) and ``load_checkpoint``/``checkpoint_metadata``
+    transparently fall back to them (``_resolve_ckpt_dir``), so no commit
+    ordering loses a restorable checkpoint.
     """
     is_proc0 = jax.process_index() == 0
     leaves = jax.tree_util.tree_flatten_with_path(state)[0]
-    os.makedirs(ckpt_dir, exist_ok=True)
+    tmp_dir = ckpt_dir.rstrip("/") + ".tmp"
+    if is_proc0:
+        # a crashed earlier save may have left a stale staging dir
+        if os.path.isdir(tmp_dir):
+            import shutil
+
+            shutil.rmtree(tmp_dir)
+        os.makedirs(tmp_dir, exist_ok=True)
+    _barrier(f"ckpt_stage:{ckpt_dir}")
+    os.makedirs(tmp_dir, exist_ok=True)
     local_ids = {d.id for d in jax.local_devices()}
+    n_procs = jax.process_count()
     manifest = {"format": _SHARDED_FORMAT, "leaves": [],
                 "metadata": extra_metadata or {}}
     for i, (path, leaf) in enumerate(leaves):
         leaf = jnp_asarray(leaf)
         shards_meta = []
-        by_device = {s.device.id: s for s in leaf.addressable_shards}
-        for k, (owner, index_key) in enumerate(_unique_shards(leaf)):
-            fname = f"leaf_{i:05d}.shard_{k:03d}.npy"
-            shards_meta.append({"file": fname,
-                                "index": [list(se) for se in index_key]})
-            if owner.id in local_ids:
-                np.save(os.path.join(ckpt_dir, fname),
-                        np.asarray(by_device[owner.id].data))
+        if n_procs > 1 and leaf.sharding.is_fully_addressable:
+            # host-local leaf (e.g. jnp.asarray of a python scalar before
+            # any jitted step): every process sees only its OWN devices in
+            # devices_indices_map, so each would elect a local owner for
+            # the same index and race np.save on the same file (round-4
+            # ADVICE low #2). Route through process 0 alone.
+            fname = f"leaf_{i:05d}.shard_000.npy"
+            shards_meta.append({
+                "file": fname,
+                "index": [[0, d] for d in leaf.shape]})
+            if is_proc0:
+                np.save(os.path.join(tmp_dir, fname), np.asarray(leaf))
+        else:
+            by_device = {s.device.id: s for s in leaf.addressable_shards}
+            for k, (owner, index_key) in enumerate(_unique_shards(leaf)):
+                fname = f"leaf_{i:05d}.shard_{k:03d}.npy"
+                shards_meta.append({"file": fname,
+                                    "index": [list(se) for se in index_key]})
+                if owner.id in local_ids:
+                    np.save(os.path.join(tmp_dir, fname),
+                            np.asarray(by_device[owner.id].data))
         manifest["leaves"].append({
             "index": i,
             "path": _path_str(path),
@@ -127,9 +169,25 @@ def save_checkpoint(ckpt_dir: str, state: Params,
             "dtype": str(leaf.dtype),
             "shards": shards_meta,
         })
+    # every shard file is on disk before the manifest exists anywhere
+    _barrier(f"ckpt_shards:{ckpt_dir}")
     if is_proc0:
-        with open(os.path.join(ckpt_dir, "manifest.json"), "w") as f:
+        import shutil
+
+        with open(os.path.join(tmp_dir, "manifest.json"), "w") as f:
             json.dump(manifest, f, indent=1)
+        old_dir = None
+        if os.path.isdir(ckpt_dir):
+            old_dir = ckpt_dir.rstrip("/") + ".old"
+            if os.path.isdir(old_dir):
+                shutil.rmtree(old_dir)
+            os.rename(ckpt_dir, old_dir)
+        os.rename(tmp_dir, ckpt_dir)
+        if old_dir is not None:
+            shutil.rmtree(old_dir)
+    # no process returns (and e.g. immediately resaves the same tag or
+    # resumes from it) before the commit rename is visible
+    _barrier(f"ckpt_commit:{ckpt_dir}")
     return ckpt_dir
 
 
@@ -207,6 +265,24 @@ def _read_leaf_slice(ckpt_dir: str, meta: dict, index) -> np.ndarray:
     return out
 
 
+def _resolve_ckpt_dir(ckpt_dir: str) -> str:
+    """Resolve a checkpoint tag to a readable dir, recovering from a save
+    preempted inside the two-rename commit window: prefer the tag itself,
+    then the completed staging dir (``.tmp`` — manifest is written there
+    last, so its presence means every shard is on disk), then the
+    displaced previous checkpoint (``.old``)."""
+    if os.path.exists(os.path.join(ckpt_dir, "manifest.json")):
+        return ckpt_dir
+    for suffix in (".tmp", ".old"):
+        cand = ckpt_dir.rstrip("/") + suffix
+        if os.path.exists(os.path.join(cand, "manifest.json")):
+            logger.warning(
+                "Checkpoint %s has no manifest (save preempted mid-commit?)"
+                "; recovering from %s", ckpt_dir, cand)
+            return cand
+    return ckpt_dir
+
+
 def load_checkpoint(ckpt_dir: str, template_state: Params,
                     shardings: Optional[Params] = None) -> Params:
     """Restore a checkpoint into the structure of ``template_state``.
@@ -221,6 +297,7 @@ def load_checkpoint(ckpt_dir: str, template_state: Params,
     Handles both the sharded-v1 format and the round-3 gathered format
     (full ``leaf_NNNNN.npy`` files).
     """
+    ckpt_dir = _resolve_ckpt_dir(ckpt_dir)
     with open(os.path.join(ckpt_dir, "manifest.json")) as f:
         manifest = json.load(f)
     sharded = manifest.get("format") == _SHARDED_FORMAT
@@ -289,7 +366,8 @@ def load_checkpoint(ckpt_dir: str, template_state: Params,
 
 
 def checkpoint_metadata(ckpt_dir: str) -> dict:
-    with open(os.path.join(ckpt_dir, "manifest.json")) as f:
+    with open(os.path.join(_resolve_ckpt_dir(ckpt_dir),
+                           "manifest.json")) as f:
         return json.load(f)["metadata"]
 
 

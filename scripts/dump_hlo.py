@@ -1,0 +1,47 @@
+"""Dump the optimized HLO of the headline train step (for profiling work:
+map xplane fusion names back to source ops).
+
+  python scripts/dump_hlo.py /tmp/headline_hlo.txt [--unroll]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+
+def main(out_path: str):
+    from building_llm_from_scratch_tpu.configs import get_config
+    from building_llm_from_scratch_tpu.models import init_params
+    from building_llm_from_scratch_tpu.training import (
+        build_optimizer,
+        get_policy,
+        init_train_state,
+        make_train_step,
+    )
+
+    cfg = get_config("GPT2", "124M", dtype="fp32")
+    policy = get_policy("bf16")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    opt = build_optimizer(total_steps=40)
+    state = init_train_state(params, opt, jax.random.PRNGKey(0), policy=policy)
+    rng = np.random.default_rng(0)
+    T = cfg.context_length
+    batch = {
+        "inputs": np.asarray(rng.integers(0, cfg.vocab_size, (8, T)), np.int32),
+        "targets": np.asarray(rng.integers(0, cfg.vocab_size, (8, T)), np.int32),
+        "weights": np.ones((8, T), np.float32),
+    }
+    step = make_train_step(cfg, opt, policy=policy)
+    compiled = step.lower(state, batch).compile()
+    txt = compiled.as_text()
+    with open(out_path, "w") as f:
+        f.write(txt)
+    print(f"wrote {len(txt)} bytes to {out_path}")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else "/tmp/headline_hlo.txt")

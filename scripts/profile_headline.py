@@ -6,11 +6,15 @@ at the headline shape with/without dropout. Run on the real chip:
 
   python scripts/profile_headline.py
 """
+import os
+import sys
 import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from building_llm_from_scratch_tpu.configs import get_config
 from building_llm_from_scratch_tpu.models import init_params

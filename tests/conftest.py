@@ -33,7 +33,29 @@ import jax  # noqa: E402
 if not RUN_ON_TPU:
     jax.config.update("jax_platforms", "cpu")
 
+import contextlib  # noqa: E402
+import tempfile  # noqa: E402
+
 import pytest  # noqa: E402
+
+
+@contextlib.contextmanager
+def distributed_spawn_lock():
+    """Cross-xdist-worker file lock for tests that spawn their own
+    jax.distributed process groups: two groups forming concurrently can
+    race on coordinator ports (observed as Gloo 'connected to N peer
+    ranks' failures when the 2-proc and 4-proc tests overlapped under
+    ``-n 4``). Serializing group formation removes the race; the lock is
+    a no-op when the suite runs single-process."""
+    import fcntl
+
+    path = os.path.join(tempfile.gettempdir(), "bllm_dist_spawn.lock")
+    with open(path, "w") as f:
+        fcntl.flock(f, fcntl.LOCK_EX)
+        try:
+            yield
+        finally:
+            fcntl.flock(f, fcntl.LOCK_UN)
 
 
 @pytest.fixture(scope="session")

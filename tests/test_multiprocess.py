@@ -24,29 +24,32 @@ def _free_port() -> int:
 
 
 def _run_workers(tmp_path, nproc: int, mode: str, timeout: int = 240):
-    port = _free_port()
+    from conftest import distributed_spawn_lock
+
     ckdir = str(tmp_path / "ckpt")
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)           # worker sets its own device count
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    procs = [
-        subprocess.Popen(
-            [sys.executable, WORKER, str(pid), str(nproc), str(port), ckdir,
-             mode],
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-            cwd=REPO, env=env)
-        for pid in range(nproc)
-    ]
-    outs = []
-    try:
-        for p in procs:
-            out, _ = p.communicate(timeout=timeout)
-            outs.append(out)
-    except subprocess.TimeoutExpired:
-        for p in procs:
-            p.kill()
-        pytest.fail("distributed workers timed out:\n" + "\n".join(
-            p.communicate()[0] or "" for p in procs))
+    with distributed_spawn_lock():
+        port = _free_port()
+        procs = [
+            subprocess.Popen(
+                [sys.executable, WORKER, str(pid), str(nproc), str(port),
+                 ckdir, mode],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+                cwd=REPO, env=env)
+            for pid in range(nproc)
+        ]
+        outs = []
+        try:
+            for p in procs:
+                out, _ = p.communicate(timeout=timeout)
+                outs.append(out)
+        except subprocess.TimeoutExpired:
+            for p in procs:
+                p.kill()
+            pytest.fail("distributed workers timed out:\n" + "\n".join(
+                p.communicate()[0] or "" for p in procs))
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {pid} failed:\n{out}"
         assert f"WORKER_{pid}_OK" in out, out
