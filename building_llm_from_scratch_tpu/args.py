@@ -69,9 +69,9 @@ def perform_checks(args) -> None:
         raise ValueError(
             f"--shard_mode {args.shard_mode} requires --run_type multi_chip.")
 
-    if args.tp > 1 and args.shard_mode not in ("tp", "tp_fsdp"):
+    if args.tp > 1 and args.shard_mode not in ("tp", "tp_fsdp", "pp"):
         raise ValueError(
-            "--tp > 1 requires --shard_mode tp or tp_fsdp.")
+            "--tp > 1 requires --shard_mode tp, tp_fsdp or pp.")
     if args.shard_mode in ("tp", "tp_fsdp") and args.tp < 2:
         raise ValueError(
             f"--shard_mode {args.shard_mode} requires --tp >= 2.")
@@ -113,13 +113,31 @@ def perform_checks(args) -> None:
             raise ValueError(
                 "--shard_mode pp does not support fp16 (the pipelined loss "
                 "has no loss-scaling state yet); use bf16.")
-        if args.tp > 1 or args.sp > 1:
-            raise ValueError("--shard_mode pp composes with neither --tp "
-                             "nor --sp yet.")
+        # pp x tp composes since round 5 (Megatron psums inside the stage
+        # body, parallel/pipeline.py); pp x sp still does not
+        if args.sp > 1:
+            raise ValueError("--shard_mode pp does not compose with --sp.")
         if args.batch_size % args.pp_micro != 0:
             raise ValueError(
                 f"--batch_size {args.batch_size} must be divisible by "
                 f"--pp_micro {args.pp_micro}.")
+
+    if args.grad_accum < 1:
+        raise ValueError("--grad_accum must be >= 1.")
+    if args.grad_accum > 1:
+        if args.batch_size % args.grad_accum:
+            raise ValueError(
+                f"--batch_size {args.batch_size} must be divisible by "
+                f"--grad_accum {args.grad_accum}.")
+        if args.shard_mode == "pp":
+            raise ValueError(
+                "--grad_accum does not compose with --shard_mode pp "
+                "(pipeline microbatching is --pp_micro).")
+        if args.mixed_precision == "bf16_hybrid":
+            raise ValueError(
+                "--grad_accum does not compose with --mixed_precision "
+                "bf16_hybrid (the explicit reduce-dtype step does not "
+                "accumulate).")
 
     if args.sp > 1:
         if args.run_type != "multi_chip":
@@ -193,6 +211,12 @@ def get_args(argv=None):
                         help="Number of training epochs.")
     parser.add_argument("--batch_size", type=int, default=4,
                         help="PER-PROCESS batch size for training.")
+    parser.add_argument("--grad_accum", type=int, default=1,
+                        help="Gradient-accumulation microbatches per step: "
+                             "the batch is split into this many microbatches "
+                             "scanned inside the jitted step (activation "
+                             "memory of one microbatch, exact full-batch "
+                             "numerics). Beyond reference parity.")
     parser.add_argument("--lr", type=float, default=5e-4,
                         help="Base (peak) learning rate.")
     parser.add_argument("--warmup_steps", type=int, default=10,

@@ -90,7 +90,8 @@ def build_plan(args) -> Optional[MeshPlan]:
         stages = args.pp or len(jax.devices())
         n_micro = args.pp_micro or 8     # perform_checks resolves this too,
         # but don't depend on its mutation for callers that skip get_args
-        plan = PipelinePlan(make_pp_mesh(stages), n_micro=n_micro)
+        plan = PipelinePlan(make_pp_mesh(stages, tp=args.tp),
+                            n_micro=n_micro)
         # fail at build time, not first-step trace: each microbatch's rows
         # must split over the mesh's data axis
         d = plan.mesh.shape["data"]

@@ -72,13 +72,18 @@ def main(args) -> Trainer:
         logger.info("Total training files detected: %d", len(files))
 
     # 4. loader (reference main.py:86-111)
+    # pp maps the STAGE axis over hosts (parallel/pipeline.py): every host
+    # runs the same data columns for its stage, so the loader must yield
+    # IDENTICAL batches on every process — per-process row sharding is for
+    # the dp/fsdp/zero1/tp modes, where hosts own disjoint batch rows
+    pp_multihost = (args.shard_mode == "pp")
     loader_kwargs = dict(
         tokenizer=comps.tokenizer,
         batch_size=args.batch_size,
         max_length=cfg.context_length,
         train_ratio=0.9,
-        process_index=jax.process_index(),
-        process_count=jax.process_count(),
+        process_index=0 if pp_multihost else jax.process_index(),
+        process_count=1 if pp_multihost else jax.process_count(),
         seed=args.seed,
     )
     if args.finetune:
@@ -108,6 +113,7 @@ def main(args) -> Trainer:
         lora_alpha=args.lora_alpha if args.use_lora else None,
         lora_rank=args.lora_rank if args.use_lora else None,
         policy=comps.policy, plan=comps.plan, seed=args.seed,
+        grad_accum=args.grad_accum,
         resume_from=args.resume_from,
         warmup_sample=True,
         profile_dir=(os.path.join(args.output_dir, "profile")

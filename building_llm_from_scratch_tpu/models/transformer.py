@@ -139,18 +139,27 @@ def _norm(cfg: ModelConfig, p: Params, x: jnp.ndarray) -> jnp.ndarray:
     return layernorm(x, p["scale"], p.get("bias"), eps=cfg.layernorm_eps)
 
 
-def _mlp(cfg: ModelConfig, p: Params, x: jnp.ndarray) -> jnp.ndarray:
+def _mlp(cfg: ModelConfig, p: Params, x: jnp.ndarray,
+         tp_axis: Optional[str] = None) -> jnp.ndarray:
+    """MLP. ``tp_axis``: Megatron column-parallel up/gate (+ their biases,
+    which are feature-sharded like the weights) and row-parallel down with
+    an explicit psum; the replicated down bias is added once after."""
     if cfg.activation == "swiglu":
         # silu(gate(x)) * up(x) -> down   (reference common_components.py:95-124)
         g = checkpoint_name(x @ p["gate"], "gate_out")
         u = checkpoint_name(x @ p["up"], "up_out")
-        return (silu(g) * u) @ p["down"]
+        h = (silu(g) * u) @ p["down"]
+        if tp_axis is not None:
+            h = jax.lax.psum(h, tp_axis)
+        return h
     h = x @ p["up"]
     if "b_up" in p:
         h = h + p["b_up"]
     h = checkpoint_name(h, "up_out")
     h = gelu(h)
     h = h @ p["down"]
+    if tp_axis is not None:
+        h = jax.lax.psum(h, tp_axis)
     if "b_down" in p:
         h = h + p["b_down"]
     return h
@@ -202,15 +211,18 @@ def _qkv_proj(cfg: ModelConfig, p: Params, x: jnp.ndarray,
     training path (_attention) and the KV-cache decode body
     (forward_with_cache); divergence here would silently break decode."""
     B, Tq, _ = x.shape
-    hd, Hq, Hkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_groups
+    hd = cfg.head_dim
     q = x @ p["wq"]
     k = x @ p["wk"]
     v = x @ p["wv"]
     if "bq" in p:
         q, k, v = q + p["bq"], k + p["bk"], v + p["bv"]
-    q = q.reshape(B, Tq, Hq, hd)
-    k = k.reshape(B, Tq, Hkv, hd)
-    v = v.reshape(B, Tq, Hkv, hd)
+    # head counts come from the PROJECTED widths, not the config: under
+    # tensor parallelism inside a shard_map each device holds Hq/ntp (and
+    # Hkv/ntp) head slices of wq/wk/wv and attends over them locally
+    q = q.reshape(B, Tq, -1, hd)
+    k = k.reshape(B, Tq, -1, hd)
+    v = v.reshape(B, Tq, -1, hd)
     if rope is not None:
         cos, sin = rope
         q = apply_rope(q, cos, sin, positions)
@@ -223,8 +235,14 @@ def _qkv_proj(cfg: ModelConfig, p: Params, x: jnp.ndarray,
     return q, k, v
 
 
-def _attn_out_proj(p: Params, out: jnp.ndarray, B: int, Tq: int) -> jnp.ndarray:
+def _attn_out_proj(p: Params, out: jnp.ndarray, B: int, Tq: int,
+                   tp_axis: Optional[str] = None) -> jnp.ndarray:
+    """Output projection; with ``tp_axis`` (Megatron row-parallel wo inside
+    a shard_map) the partial products psum over the model axis and the
+    bias — replicated, not sharded — is added exactly once AFTER."""
     out = out.reshape(B, Tq, -1) @ p["wo"]
+    if tp_axis is not None:
+        out = jax.lax.psum(out, tp_axis)
     if "bo" in p:
         out = out + p["bo"]
     return out
@@ -236,7 +254,7 @@ def _attention(cfg: ModelConfig, p: Params, x: jnp.ndarray,
                cache_kv: Optional[Tuple[jnp.ndarray, jnp.ndarray]],
                cache_len: Optional[jnp.ndarray],
                rng: Optional[jax.Array], deterministic: bool,
-               sp_mesh=None, sp_inside=None):
+               sp_mesh=None, sp_inside=None, tp_axis=None):
     """Per-block attention; returns (out, new_cache_kv)."""
     B, Tq, D = x.shape
     hd = cfg.head_dim
@@ -300,25 +318,40 @@ def _attention(cfg: ModelConfig, p: Params, x: jnp.ndarray,
             impl=cfg.attn_impl,
         )
     out = checkpoint_name(out, "attn_out")
-    out = _attn_out_proj(p, out, B, Tq)
+    out = _attn_out_proj(p, out, B, Tq, tp_axis=tp_axis)
     return out, new_cache
 
 
 def _block(cfg: ModelConfig, p: Params, x: jnp.ndarray,
            rope, positions, cache_kv, cache_len, rng, deterministic,
-           sp_mesh=None, sp_inside=None):
-    """Pre-norm transformer block (reference GPT2.py:68-88, Llama3.py:159-181)."""
+           sp_mesh=None, sp_inside=None, tp_axis=None):
+    """Pre-norm transformer block (reference GPT2.py:68-88, Llama3.py:159-181).
+
+    ``tp_axis``: Megatron tensor parallelism INSIDE a shard_map — the
+    caller feeds head-/feature-sharded wq/wk/wv/up(/gate) and input-sharded
+    wo/down slices; this block attends over its local heads and psums the
+    two row-parallel projections over the named axis (used by the pipeline
+    schedule for pp x tp; the GSPMD tp path shards the same rule table
+    outside shard_map instead)."""
     if rng is not None:
         r_attn, r_res1, r_res2 = jax.random.split(rng, 3)
+        if tp_axis is not None and not deterministic:
+            # attention-weight masks cover LOCAL head slices — fold the
+            # model-shard index so global heads get iid masks. Residual
+            # dropout keys stay UNfolded: they apply to the replicated
+            # post-psum activations, which must mask identically on every
+            # model shard or the replicas diverge.
+            r_attn = jax.random.fold_in(r_attn,
+                                        jax.lax.axis_index(tp_axis))
     else:
         r_attn = r_res1 = r_res2 = None
     h, new_cache = _attention(cfg, p["attn"], _norm(cfg, p["norm1"], x),
                               rope, positions, cache_kv, cache_len,
                               r_attn, deterministic, sp_mesh=sp_mesh,
-                              sp_inside=sp_inside)
+                              sp_inside=sp_inside, tp_axis=tp_axis)
     x = _residual_dropout(x, h, cfg.drop_rate, r_res1, deterministic)
     x = checkpoint_name(x, "resid_mid")
-    h = _mlp(cfg, p["mlp"], _norm(cfg, p["norm2"], x))
+    h = _mlp(cfg, p["mlp"], _norm(cfg, p["norm2"], x), tp_axis=tp_axis)
     x = _residual_dropout(x, h, cfg.drop_rate, r_res2, deterministic)
     return x, new_cache
 
@@ -422,6 +455,12 @@ def forward_hidden(params: Params, cfg: ModelConfig, tokens: jnp.ndarray, *,
         # chains (norms, GELU/SiLU, residual adds) in the backward: no
         # matmul and no attention-kernel recompute, ~4x less scan-carried
         # HBM traffic.
+        # Only the fused kernel names its out+lse residuals
+        # (fused_attention._fused_fwd_rule) — under the non-fused impls
+        # (xla/flash; CPU tests, explicit --attn_impl) the backward
+        # recomputes the attention scores/softmax from the saved q/k/v,
+        # flash-style: more VPU work than r4's save-everything, far less
+        # memory. The TPU default ('auto' -> fused) is unaffected.
         body = jax.checkpoint(
             body, prevent_cse=False,
             policy=jax.checkpoint_policies.save_only_these_names(

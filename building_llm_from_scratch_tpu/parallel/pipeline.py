@@ -60,6 +60,20 @@ Params = Dict[str, Any]
 
 STAGE_AXIS = "stage"
 DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+# Megatron rules on the per-layer block tree for pp x tp (round-5 VERDICT
+# #6): axis (on the UNSTACKED per-layer shape) to shard over the model
+# mesh axis. Column-parallel qkv/up/gate + their feature-sharded biases,
+# row-parallel wo/down (their replicated biases are added post-psum in
+# transformer._attn_out_proj/_mlp).
+_PP_TP_RULES = {
+    ("attn", "wq"): 1, ("attn", "wk"): 1, ("attn", "wv"): 1,
+    ("attn", "bq"): 0, ("attn", "bk"): 0, ("attn", "bv"): 0,
+    ("attn", "wo"): 0,
+    ("mlp", "up"): 1, ("mlp", "gate"): 1, ("mlp", "b_up"): 0,
+    ("mlp", "down"): 0,
+}
 
 # Ablation switch for scripts/bench_pp.py ONLY: False reproduces the r3
 # schedule where every stage computed on every tick (stage 0 re-ran its
@@ -68,22 +82,27 @@ DATA_AXIS = "data"
 GATE_INVALID_TICKS = True
 
 
-def make_pp_mesh(n_stages: int, devices=None) -> Mesh:
-    """A (data=D, stage=S) mesh: the stage axis takes ``n_stages`` devices
-    and the data axis absorbs the rest (D = n_devices / S), so every device
-    works — microbatches shard their rows over data while activations
-    pipeline over stage."""
+def make_pp_mesh(n_stages: int, devices=None, tp: int = 1) -> Mesh:
+    """A (data=D, stage=S, model=T) mesh: the stage axis takes
+    ``n_stages`` blocks of CONTIGUOUS devices and the data axis absorbs
+    the rest (D = n_devices / S / T) — microbatches shard their rows over
+    data, activations pipeline over stage, and (tp > 1) attention heads /
+    MLP features split over model.
+
+    Stage-contiguous device order makes the stage axis map over HOSTS on
+    multi-process runs (jax.devices() orders by process): a 2-host pod
+    with --pp 2 puts stage 0 on host 0 and stage 1 on host 1, so the
+    per-tick ppermute hop is the only inter-host traffic (round-5 VERDICT
+    #5 — multi-host pp)."""
     devices = list(devices if devices is not None else jax.devices())
-    if jax.process_count() > 1:
-        raise NotImplementedError(
-            "pipeline parallelism is single-process for now (its batch "
-            "placement replicates; multi-host feeds are not wired)")
-    if len(devices) % n_stages != 0:
+    if len(devices) % (n_stages * tp) != 0:
         raise ValueError(
-            f"{len(devices)} devices not divisible by {n_stages} stages")
-    d = len(devices) // n_stages
-    arr = np.asarray(devices).reshape(d, n_stages)
-    return Mesh(arr, (DATA_AXIS, STAGE_AXIS))
+            f"{len(devices)} devices not divisible by {n_stages} stages "
+            f"x {tp} model shards")
+    d = len(devices) // n_stages // tp
+    # stage-major: stage s owns the contiguous block devices[s*d*tp:(s+1)*d*tp]
+    arr = np.asarray(devices).reshape(n_stages, d, tp).transpose(1, 0, 2)
+    return Mesh(arr, (DATA_AXIS, STAGE_AXIS, MODEL_AXIS))
 
 
 def _stack_blocks(blocks: Params, n_stages: int) -> Params:
@@ -95,12 +114,57 @@ def _stack_blocks(blocks: Params, n_stages: int) -> Params:
     return jax.tree_util.tree_map(reshape, blocks)
 
 
+def _tp_rule_axis(path) -> Optional[int]:
+    """Model-shard axis (on the UNSTACKED per-layer shape) for a blocks
+    leaf, or None if the leaf replicates over model."""
+    names = tuple(p if isinstance(p, str) else str(getattr(p, "key", ""))
+                  for p in path)
+    for suffix, ax in _PP_TP_RULES.items():
+        if names[-len(suffix):] == suffix:
+            return ax
+    return None
+
+
+def _block_leaf_spec(path, shape, n_tp: int, lead: int) -> P:
+    """PartitionSpec for one blocks leaf: stage axis on dim 0, plus
+    (tp > 1) the Megatron model axis at rule-axis + ``lead`` — the ONE
+    implementation behind the shard_map in_specs (lead=2: stage-major
+    (S, L/S, ...) layout), the state shardings and the weight-loading
+    param specs (lead=1: stacked (L, ...) layout). Trailing Nones are
+    trimmed so specs compare equal to their canonical form."""
+    ndim = len(shape)
+    spec: list = [None] * ndim
+    if ndim >= 1:
+        spec[0] = STAGE_AXIS
+    ax = _tp_rule_axis(path) if n_tp > 1 else None
+    if ax is not None and ax + lead < ndim and shape[ax + lead] % n_tp == 0:
+        spec[ax + lead] = MODEL_AXIS
+    while spec and spec[-1] is None:
+        spec.pop()
+    return P(*spec)
+
+
+def _stage_block_specs(stage_blocks: Params, n_tp: int) -> Params:
+    """shard_map in_specs for the stage-major (S, L/S, per-layer...) block
+    tree."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: _block_leaf_spec(path, np.shape(leaf), n_tp,
+                                            lead=2),
+        stage_blocks)
+
+
 def stage_shardings(params: Params, mesh: Mesh) -> Params:
-    """Shardings for pp: block params stage-sharded, the rest replicated."""
+    """Shardings for pp: block params shard their (L, ...) layer axis over
+    stage (contiguous L/S chunks — matching the loss's stage-major
+    reshape) plus, when the mesh has a model axis > 1, the Megatron rule
+    axis over model; everything else replicates."""
+    n_tp = mesh.shape.get(MODEL_AXIS, 1)
+
     def spec_of(path, leaf):
         names = [getattr(p, "key", None) for p in path]
         if "blocks" in names and np.ndim(leaf) >= 1:
-            return NamedSharding(mesh, P(STAGE_AXIS))
+            return NamedSharding(
+                mesh, _block_leaf_spec(path, np.shape(leaf), n_tp, lead=1))
         return NamedSharding(mesh, P())
 
     return jax.tree_util.tree_map_with_path(spec_of, params)
@@ -113,9 +177,16 @@ def make_pp_loss_fn(cfg: ModelConfig, mesh: Mesh, n_micro: int
     stage split happens inside. Differentiable — wrap in
     jax.value_and_grad. ``rng=None`` (or drop_rate 0) disables dropout."""
     S = mesh.shape[STAGE_AXIS]
+    n_tp = mesh.shape.get(MODEL_AXIS, 1)
+    tp_axis = MODEL_AXIS if n_tp > 1 else None
     if cfg.n_layers % S != 0:
         raise ValueError(
             f"n_layers {cfg.n_layers} not divisible by {S} stages")
+    if n_tp > 1 and (cfg.n_heads % n_tp or cfg.n_kv_groups % n_tp
+                     or cfg.hidden_dim % n_tp):
+        raise ValueError(
+            f"tp={n_tp} must divide n_heads {cfg.n_heads}, n_kv_groups "
+            f"{cfg.n_kv_groups} and hidden_dim {cfg.hidden_dim}")
     rope = _rope_tables(cfg)
     layers_per_stage = cfg.n_layers // S
 
@@ -130,7 +201,7 @@ def make_pp_loss_fn(cfg: ModelConfig, mesh: Mesh, n_micro: int
             p, j = xs
             r = None if deterministic else jax.random.fold_in(key, j)
             y, _ = _block(cfg, p, carry, rope, None, None, None, r,
-                          deterministic)
+                          deterministic, tp_axis=tp_axis)
             return y, None
 
         if cfg.use_actv_ckpt:
@@ -186,11 +257,15 @@ def make_pp_loss_fn(cfg: ModelConfig, mesh: Mesh, n_micro: int
 
             # warmup/drain ticks with no valid micro skip ALL compute
             # (device-local cond — r3 burned a full stage forward per
-            # drain tick on stage 0, ADVICE #4)
-            if GATE_INVALID_TICKS:
+            # drain tick on stage 0, ADVICE #4). With tensor parallelism
+            # the stage body contains psums over the model axis, and a
+            # collective inside a cond whose predicate differs per stage
+            # would desynchronize the SPMD program — so pp x tp always
+            # computes and discards invalid ticks' results instead.
+            if GATE_INVALID_TICKS and n_tp == 1:
                 act = jax.lax.cond(valid, run, lambda a: a, act)
-            else:                      # r3-equivalent ablation (bench only)
-                act = run(act)
+            else:
+                act = jnp.where(valid, run(act), act)
 
             # last stage: microbatch (t - (S-1)) completes on tick t. The
             # V-sized head projection is the most expensive matmul in the
@@ -241,32 +316,48 @@ def make_pp_loss_fn(cfg: ModelConfig, mesh: Mesh, n_micro: int
 
     def loss_fn(params: Params, batch: Dict[str, jnp.ndarray],
                 rng: Optional[jax.Array] = None) -> jnp.ndarray:
-        B, T = batch["inputs"].shape
         D_data = mesh.shape[DATA_AXIS]
-        if B % n_micro != 0:
-            raise ValueError(
-                f"batch size {B} not divisible by n_micro {n_micro}")
-        Bm = B // n_micro
-        if Bm % D_data != 0:
-            raise ValueError(
-                f"microbatch rows {Bm} not divisible by the data axis "
-                f"{D_data} (batch {B} / n_micro {n_micro})")
-        mb = lambda x: x.reshape(n_micro, Bm, *x.shape[1:])
-        inputs = mb(batch["inputs"])
-        targets = mb(batch["targets"])
-        weights = mb(batch.get("weights",
-                               jnp.ones_like(batch["targets"], jnp.float32)))
+        if batch["inputs"].ndim == 3:
+            # pre-microbatched (M, Bm_global, T) feed — the multi-host
+            # path: PipelinePlan.shard_batch assembled it from per-process
+            # rows (make_array_from_process_local_data), already sharded
+            # over the data axis
+            inputs = batch["inputs"]
+            targets = batch["targets"]
+            weights = batch.get("weights")
+            if weights is None:
+                weights = jnp.ones_like(targets, jnp.float32)
+            if inputs.shape[0] != n_micro:
+                raise ValueError(
+                    f"pre-microbatched batch has M={inputs.shape[0]}, "
+                    f"expected n_micro={n_micro}")
+        else:
+            B, T = batch["inputs"].shape
+            if B % n_micro != 0:
+                raise ValueError(
+                    f"batch size {B} not divisible by n_micro {n_micro}")
+            Bm = B // n_micro
+            if Bm % D_data != 0:
+                raise ValueError(
+                    f"microbatch rows {Bm} not divisible by the data axis "
+                    f"{D_data} (batch {B} / n_micro {n_micro})")
+            mb = lambda x: x.reshape(n_micro, Bm, *x.shape[1:])
+            inputs = mb(batch["inputs"])
+            targets = mb(batch["targets"])
+            weights = mb(batch.get(
+                "weights", jnp.ones_like(batch["targets"], jnp.float32)))
 
         stage_blocks = _stack_blocks(params["blocks"], S)
         other = {k: v for k, v in params.items() if k != "blocks"}
 
         rep = P()
+        blk_specs = _stage_block_specs(stage_blocks, n_tp)
         mb_spec = P(None, DATA_AXIS)   # each data column pipelines its rows
         if rng is not None and cfg.drop_rate > 0.0:
             fn = jax.shard_map(
                 pp_body,
                 mesh=mesh,
-                in_specs=(rep, P(STAGE_AXIS), mb_spec, mb_spec, mb_spec,
+                in_specs=(rep, blk_specs, mb_spec, mb_spec, mb_spec,
                           rep),
                 out_specs=rep,
                 check_vma=False,
@@ -275,7 +366,7 @@ def make_pp_loss_fn(cfg: ModelConfig, mesh: Mesh, n_micro: int
         fn = jax.shard_map(
             lambda p, b, i, t, w: pp_body(p, b, i, t, w, None),
             mesh=mesh,
-            in_specs=(rep, P(STAGE_AXIS), mb_spec, mb_spec, mb_spec),
+            in_specs=(rep, blk_specs, mb_spec, mb_spec, mb_spec),
             out_specs=rep,
             check_vma=False,
         )
@@ -297,6 +388,7 @@ class PipelinePlan:
         self.mesh = mesh
         self.n_micro = n_micro
         self.n_stages = mesh.shape[STAGE_AXIS]
+        self.n_tp = mesh.shape.get(MODEL_AXIS, 1)
 
     def _named(self, spec: P) -> NamedSharding:
         return NamedSharding(self.mesh, spec)
@@ -304,10 +396,11 @@ class PipelinePlan:
     def param_spec(self, names, shape) -> P:
         """Spec for one model-param leaf (the weight-conversion path places
         each converted tensor straight onto its sharding): block leaves
-        stage-shard their layer axis, everything else replicates."""
+        stage-shard their layer axis (+ model axis per the Megatron rules
+        when tp > 1), everything else replicates."""
         if "blocks" in names and len(shape) >= 1 \
                 and shape[0] % self.n_stages == 0:
-            return P(STAGE_AXIS)
+            return _block_leaf_spec(tuple(names), shape, self.n_tp, lead=1)
         return P()
 
     def state_shardings(self, state: Params) -> Params:
@@ -330,15 +423,41 @@ class PipelinePlan:
         return jax.tree_util.tree_map(put_fresh, params, shardings)
 
     def shard_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
-        """Replicated placement. Row-sharding the (B, T) batch over the
-        data axis would NOT line up with the microbatch-major (M, Bm)
-        split the loss performs (contiguous B-chunks span multiple
-        microbatches), so GSPMD would reshard at the shard_map boundary
-        anyway; replicating the small host batch keeps the transfer simple
-        and lets the shard_map slice locally."""
-        rep = self._named(P())
-        return jax.tree_util.tree_map(
-            lambda x: jax.device_put(x, rep), batch)
+        """Single-process: replicated placement — row-sharding the (B, T)
+        batch over the data axis would NOT line up with the
+        microbatch-major (M, Bm) split the loss performs (contiguous
+        B-chunks span multiple microbatches), so GSPMD would reshard at
+        the shard_map boundary anyway; replicating the small host batch
+        keeps the transfer simple and lets the shard_map slice locally.
+
+        Multi-process (round-5 VERDICT #5): the stage axis maps over
+        hosts, so the data axis is HOST-LOCAL per stage and every process
+        must feed the SAME global rows (activations for data column i hop
+        between the stage replicas of column i across hosts — main.py
+        disables per-process loader sharding for pp). The batch is
+        reshaped host-side into the microbatch-major (M, Bm, T) layout
+        and placed via ``make_array_from_process_local_data``: each
+        process supplies the full rows and its devices pick up their data
+        columns. The loss detects the rank-3 feed and skips its own
+        reshape."""
+        if jax.process_count() == 1:
+            rep = self._named(P())
+            return jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, rep), batch)
+
+        mb_sharding = self._named(P(None, DATA_AXIS))
+
+        def put(x):
+            B = x.shape[0]
+            if B % self.n_micro:
+                raise ValueError(
+                    f"batch {B} not divisible by n_micro {self.n_micro}")
+            local = x.reshape(self.n_micro, B // self.n_micro,
+                              *x.shape[1:])
+            return jax.make_array_from_process_local_data(
+                mb_sharding, local, global_shape=local.shape)
+
+        return jax.tree_util.tree_map(put, batch)
 
 
 def make_pp_train_step(cfg: ModelConfig, optimizer, mesh: Mesh, *,

@@ -153,16 +153,27 @@ def make_train_step(cfg: ModelConfig, optimizer: optax.GradientTransformation,
                     policy: Optional[PrecisionPolicy] = None,
                     sp_mesh=None,
                     use_fused_xent: Optional[bool] = None,
+                    grad_accum: int = 1,
                     jit: bool = True) -> Callable:
     """Build train_step(state, batch) -> (state, metrics).
 
     batch: {"inputs": (B,T) i32, "targets": (B,T) i32, "weights": (B,T) f32}.
     ``sp_mesh``: mesh with seq axis > 1 routes attention through the ring
     schedule (sequence parallelism; see ops/ring_attention.py).
+    ``grad_accum`` > 1 splits the batch into that many microbatches and
+    runs them through a ``lax.scan`` INSIDE the jitted step, accumulating
+    fp32 gradients and the weighted-CE numerator/denominator — activation
+    memory is one microbatch's, numerics are the full-batch weighted mean
+    exactly (accumulate-then-normalize; parity test
+    tests/test_training.py::test_grad_accum_matches_full_batch). Composes
+    with every GSPMD shard mode (the scan body is ordinary sharded
+    compute); each microbatch gets its own folded dropout stream.
     """
     full_params = make_full_params_fn(cfg, lora_alpha=lora_alpha,
                                       lora_rank=lora_rank, policy=policy)
-    loss_impl, _ = make_loss_fns(cfg, use_fused_xent)
+    loss_impl, sums_impl = make_loss_fns(cfg, use_fused_xent)
+    if grad_accum < 1:
+        raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
 
     def train_step(state: Params, batch: Dict[str, jnp.ndarray]
                    ) -> Tuple[Params, Dict[str, jnp.ndarray]]:
@@ -181,9 +192,61 @@ def make_train_step(cfg: ModelConfig, optimizer: optax.GradientTransformation,
         return _finish_step(state, loss, grads, batch["inputs"].size,
                             optimizer, lr_schedule, policy)
 
+    def train_step_accum(state: Params, batch: Dict[str, jnp.ndarray]
+                         ) -> Tuple[Params, Dict[str, jnp.ndarray]]:
+        B = batch["inputs"].shape[0]
+        if B % grad_accum:
+            raise ValueError(
+                f"batch size {B} not divisible by grad_accum {grad_accum}")
+        mb = B // grad_accum
+        if "weights" not in batch:
+            batch = dict(batch, weights=jnp.ones_like(
+                batch["targets"], jnp.float32))
+        micro = jax.tree_util.tree_map(
+            lambda x: x.reshape(grad_accum, mb, *x.shape[1:]), batch)
+        step_rng = jax.random.fold_in(state["rng"], state["step"])
+        scale = state.get("loss_scale")
+
+        def body(carry, xs):
+            g_acc, nll_acc, w_acc = carry
+            mb_batch, idx = xs
+            rng_m = jax.random.fold_in(step_rng, idx)
+
+            def loss_fn(trainable):
+                params = full_params(trainable, state["frozen"])
+                hidden = forward_hidden(params, cfg, mb_batch["inputs"],
+                                        rng=rng_m,
+                                        deterministic=(cfg.drop_rate <= 0.0),
+                                        sp_mesh=sp_mesh)
+                nll, w = sums_impl(params, hidden, mb_batch["targets"],
+                                   mb_batch["weights"])
+                scaled = nll if scale is None else nll * scale
+                return scaled, (nll, w)
+
+            (_, (nll, w)), g = jax.value_and_grad(loss_fn, has_aux=True)(
+                state["trainable"])
+            g_acc = jax.tree_util.tree_map(
+                lambda a, b: a + b.astype(jnp.float32), g_acc, g)
+            return (g_acc, nll_acc + nll, w_acc + w), None
+
+        g0 = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), state["trainable"])
+        (g_sum, nll_sum, w_sum), _ = jax.lax.scan(
+            body, (g0, jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+            (micro, jnp.arange(grad_accum)))
+        denom = jnp.maximum(w_sum, 1.0)
+        if scale is not None:
+            # grads carry the loss scale; divide it out with the weight sum
+            denom = denom * scale
+        grads = jax.tree_util.tree_map(lambda g: g / denom, g_sum)
+        loss = nll_sum / jnp.maximum(w_sum, 1.0)
+        return _finish_step(state, loss, grads, batch["inputs"].size,
+                            optimizer, lr_schedule, policy)
+
+    fn = train_step if grad_accum == 1 else train_step_accum
     if jit:
-        return jax.jit(train_step, donate_argnums=(0,))
-    return train_step
+        return jax.jit(fn, donate_argnums=(0,))
+    return fn
 
 
 def _compute_grads(loss_fn: Callable, state: Params):

@@ -74,6 +74,7 @@ class Trainer:
                  lora_alpha: Optional[float] = None,
                  lora_rank: Optional[int] = None,
                  policy=None, plan=None, seed: int = 123,
+                 grad_accum: int = 1,
                  resume_from: Optional[str] = None,
                  warmup_sample: bool = False,
                  profile_dir: Optional[str] = None,
@@ -96,6 +97,7 @@ class Trainer:
         self.policy = policy
         self.plan = plan
         self.seed = seed
+        self.grad_accum = grad_accum
         self.resume_from = resume_from
         self.warmup_sample = warmup_sample
         self.profile_dir = profile_dir
@@ -190,6 +192,14 @@ class Trainer:
                   policy=self.policy,
                   sp_mesh=(self.plan.sp_mesh if self.plan is not None
                            else None))
+        if self.grad_accum > 1 and self.plan is not None and (
+                self.plan.shard_mode == "pp"
+                or (self.policy is not None
+                    and self.policy.reduce_dtype != self.policy.compute_dtype)):
+            raise ValueError(
+                "--grad_accum composes with the GSPMD step only: pp has its "
+                "own microbatching (--pp_micro) and the explicit "
+                "reduce-dtype step does not accumulate")
         if self.plan is not None and self.plan.shard_mode == "pp":
             from building_llm_from_scratch_tpu.parallel.pipeline import (
                 make_pp_eval_step,
@@ -229,7 +239,8 @@ class Trainer:
                     "(dp/fsdp/zero1 only); rejecting rather than silently "
                     "reducing in the compute dtype")
             self.train_step = make_train_step(
-                self.cfg, self.optimizer, lr_schedule=self.lr_schedule, **kw)
+                self.cfg, self.optimizer, lr_schedule=self.lr_schedule,
+                grad_accum=self.grad_accum, **kw)
         self.eval_step = make_eval_step(self.cfg, **kw)
 
     def _device_batch(self, arrays: Sequence[np.ndarray]) -> Dict[str, Any]:

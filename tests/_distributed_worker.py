@@ -50,6 +50,11 @@ def main():
 
     cfg = get_config("GPT2", "124M", debug=True).replace(
         emb_dim=64, hidden_dim=128, vocab_size=256, drop_rate=0.0)
+
+    if mode == "pp":
+        _run_pp(pid, nproc, cfg)
+        return
+
     plan = build_mesh_plan(mode)
     params = init_params(cfg, jax.random.PRNGKey(0))   # same on all procs
     opt = build_optimizer(total_steps=10)
@@ -111,6 +116,59 @@ def main():
     assert np.isfinite(float(m["loss"]))
     assert int(restored["step"]) == 4
     sync_global_devices("done")
+    print(f"WORKER_{pid}_OK", flush=True)
+
+
+def _run_pp(pid, nproc, cfg):
+    """Multi-host pipeline parallelism (round-5 VERDICT #5): stage axis
+    mapped over hosts (stage-contiguous device order), per-process
+    microbatch feeds via make_array_from_process_local_data, 3 finite
+    train steps."""
+    import jax
+
+    from building_llm_from_scratch_tpu.parallel import sync_global_devices
+    from building_llm_from_scratch_tpu.parallel.pipeline import (
+        PipelinePlan,
+        make_pp_mesh,
+        make_pp_train_step,
+    )
+    from building_llm_from_scratch_tpu.models import init_params
+    from building_llm_from_scratch_tpu.training import (
+        build_optimizer,
+        init_train_state,
+    )
+
+    cfg = cfg.replace(n_layers=4, context_length=16)
+    # n_stages = n_processes: with the stage-contiguous device order each
+    # host owns exactly one stage — the per-tick ppermute hop is the only
+    # inter-host traffic
+    plan = PipelinePlan(make_pp_mesh(nproc), n_micro=2)
+    opt = build_optimizer(total_steps=10)
+    state = plan.shard_state(init_train_state(
+        init_params(cfg, jax.random.PRNGKey(0)), opt, jax.random.PRNGKey(0)))
+    wq = state["trainable"]["blocks"]["attn"]["wq"]
+    assert not wq.is_fully_addressable       # stage axis spans hosts
+    step = make_pp_train_step(cfg, opt, plan.mesh, n_micro=plan.n_micro)
+
+    # stage-over-hosts: every process feeds the SAME rows (the data axis
+    # is host-local per stage) — fixed seed, NOT pid-dependent
+    np.random.seed(0)
+    losses = []
+    bs = 2 * plan.mesh.shape["data"]     # Bm = bs/n_micro divides data axis
+    for i in range(3):
+        x = np.random.randint(0, cfg.vocab_size,
+                              (bs, cfg.context_length)).astype(np.int32)
+        batch = plan.shard_batch({
+            "inputs": x,
+            "targets": np.roll(x, -1, 1).astype(np.int32),
+            "weights": np.ones_like(x, np.float32),
+        })
+        assert batch["inputs"].ndim == 3      # (M, Bm_global, T) feed
+        assert batch["inputs"].shape[0] == plan.n_micro
+        state, m = step(state, batch)
+        losses.append(float(m["loss"]))
+    assert np.isfinite(losses).all(), losses
+    sync_global_devices("pp_done")
     print(f"WORKER_{pid}_OK", flush=True)
 
 

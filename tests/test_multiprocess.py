@@ -61,6 +61,13 @@ def test_two_process_fsdp_train_and_checkpoint(tmp_path):
     _run_workers(tmp_path, nproc=2, mode="fsdp")
 
 
+def test_two_process_pipeline_parallel(tmp_path):
+    """2 hosts x 4 devices, pp: stage axis over hosts, per-process
+    microbatch feeds, 3 finite pipelined train steps (round-5 VERDICT
+    #5 — pipeline parallelism leaves one host)."""
+    _run_workers(tmp_path, nproc=2, mode="pp")
+
+
 def test_four_process_zero1_resume(tmp_path):
     """4 hosts x 4 devices (16-device mesh), zero1 optimizer-state
     sharding: train, sharded save, restore, resume (round-3 VERDICT

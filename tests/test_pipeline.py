@@ -106,6 +106,95 @@ def test_pp_training_matches_single_device():
     np.testing.assert_allclose(got_w, ref_w, rtol=2e-3, atol=2e-5)
 
 
+def test_pp_tp_loss_and_gradients_match_single_device():
+    """pp x tp (round-5 VERDICT #6): (data=2, stage=2, model=2) mesh —
+    loss AND every RAW gradient leaf match single-device. No manual
+    gradient corrections exist or are needed: jax's shard_map transpose
+    differentiates through the Megatron psums exactly (the torch-world
+    f/g conjugate pair is an autograd workaround jax does not require —
+    an earlier draft that added it produced garbage gradients)."""
+    from building_llm_from_scratch_tpu.parallel.pipeline import MODEL_AXIS
+
+    cfg = _cfg(n_layers=4)
+    mesh = make_pp_mesh(2, tp=2)
+    assert mesh.shape == {"data": 2, "stage": 2, MODEL_AXIS: 2}
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    batch = _batch(cfg)
+
+    want = float(_ref_loss(params, cfg, batch))
+    loss_fn = make_pp_loss_fn(cfg, mesh, n_micro=2)
+    got = float(jax.jit(loss_fn)(params, batch))
+    assert abs(got - want) < 1e-5, (got, want)
+
+    # RAW gradient parity — adam-step parity alone would be blind to
+    # per-leaf scale errors (m/sqrt(v) cancels constant factors)
+    gw = jax.grad(lambda p: _ref_loss(p, cfg, batch))(params)
+    gp = jax.jit(jax.grad(loss_fn))(params, batch)
+    for (path, a), b in zip(jax.tree_util.tree_leaves_with_path(gw),
+                            jax.tree_util.tree_leaves(gp)):
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), atol=1e-5, rtol=1e-4,
+            err_msg=str(path))
+
+    # gradient parity through the full train step (which applies the
+    # replicated-grad 1/tp correction)
+    opt = build_optimizer(peak_lr=1e-3, warmup_steps=2, total_steps=10)
+    ref_state = init_train_state(init_params(cfg, jax.random.PRNGKey(0)),
+                                 opt, jax.random.PRNGKey(0))
+    ref_step = make_train_step(cfg, opt)
+    state = init_train_state(init_params(cfg, jax.random.PRNGKey(0)),
+                             opt, jax.random.PRNGKey(0))
+    state = jax.device_put(state, stage_shardings(state, mesh))
+    step = make_pp_train_step(cfg, opt, mesh, n_micro=2)
+    for seed in range(2):
+        b = _batch(cfg, seed=seed)
+        ref_state, mr = ref_step(ref_state, b)
+        state, mp = step(state, b)
+        np.testing.assert_allclose(float(mp["loss"]), float(mr["loss"]),
+                                   rtol=2e-4, atol=2e-5)
+    for (path, a), b in zip(
+            jax.tree_util.tree_leaves_with_path(ref_state["trainable"]),
+            jax.tree_util.tree_leaves(state["trainable"])):
+        np.testing.assert_allclose(np.asarray(jax.device_get(b)),
+                                   np.asarray(a), rtol=2e-3, atol=2e-5,
+                                   err_msg=str(path))
+
+
+def test_pp_tp_state_shardings_split_model_axis():
+    from jax.sharding import PartitionSpec as P
+
+    cfg = _cfg(n_layers=4)
+    mesh = make_pp_mesh(2, tp=2)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    sh = stage_shardings(params, mesh)
+    assert sh["blocks"]["attn"]["wq"].spec == P("stage", None, "model")
+    assert sh["blocks"]["attn"]["wo"].spec == P("stage", "model")
+    assert sh["blocks"]["mlp"]["up"].spec == P("stage", None, "model")
+    assert sh["blocks"]["mlp"]["down"].spec == P("stage", "model")
+    assert sh["blocks"]["norm1"]["scale"].spec == P("stage")
+    assert sh["tok_emb"]["weight"].spec == P()
+
+
+def test_pp_tp_dropout_trains_gpt2():
+    """GPT-2 (dropout 0.1, qkv biases) under pp x tp: runs and the loss is
+    finite — attention masks fold the model-shard index, residual masks
+    stay shard-identical (transformer._block)."""
+    cfg = get_config("GPT2", "124M", debug=True).replace(
+        emb_dim=64, hidden_dim=128, vocab_size=512, context_length=64,
+        n_layers=4, dtype="fp32")
+    mesh = make_pp_mesh(2, tp=2)
+    opt = build_optimizer(total_steps=10)
+    state = init_train_state(init_params(cfg, jax.random.PRNGKey(0)),
+                             opt, jax.random.PRNGKey(0))
+    state = jax.device_put(state, stage_shardings(state, mesh))
+    step = make_pp_train_step(cfg, opt, mesh, n_micro=2)
+    losses = []
+    for seed in range(3):
+        state, m = step(state, _batch(cfg, seed=seed))
+        losses.append(float(m["loss"]))
+    assert np.isfinite(losses).all(), losses
+
+
 def test_pp_lora_matches_single_device():
     """pp + LoRA: adapters merge before the stage split; losses match the
     plain LoRA step and ONLY the adapters update."""
