@@ -210,7 +210,10 @@ def get_args(argv=None):
     parser.add_argument("--n_epochs", type=int, default=2,
                         help="Number of training epochs.")
     parser.add_argument("--batch_size", type=int, default=4,
-                        help="PER-PROCESS batch size for training.")
+                        help="PER-PROCESS batch size for training. "
+                             "Exception: under --shard_mode pp this is the "
+                             "GLOBAL batch — the stage axis maps over "
+                             "hosts, so every process feeds the same rows.")
     parser.add_argument("--grad_accum", type=int, default=1,
                         help="Gradient-accumulation microbatches per step: "
                              "the batch is split into this many microbatches "

@@ -87,7 +87,7 @@ def build_plan(args) -> Optional[MeshPlan]:
             make_pp_mesh,
         )
 
-        stages = args.pp or len(jax.devices())
+        stages = args.pp or max(1, len(jax.devices()) // args.tp)
         n_micro = args.pp_micro or 8     # perform_checks resolves this too,
         # but don't depend on its mutation for callers that skip get_args
         plan = PipelinePlan(make_pp_mesh(stages, tp=args.tp),

@@ -236,6 +236,8 @@ def _fwd(q, k, v, seed, *, scale, rate, bq, bk):
             jax.ShapeDtypeStruct((B, Hq, T, D), q.dtype),
             jax.ShapeDtypeStruct((B, Hq, T, LANES), jnp.float32),
         ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel")),
     )(seed, q, k, v)
     return out, lse
 
@@ -270,6 +272,8 @@ def _bwd(q, k, v, seed, out, lse, do, *, scale, rate, bq, bk):
                   lane_blk],
         out_specs=pl.BlockSpec((1, 1, bq, D), lambda b, h, i: (b, h, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B, Hq, T, D), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel")),
     )(seed, q, k, v, do, lse, delta)
 
     dk, dv = pl.pallas_call(
@@ -286,6 +290,8 @@ def _bwd(q, k, v, seed, out, lse, do, *, scale, rate, bq, bk):
             jax.ShapeDtypeStruct((B, Hq, T, D), q.dtype),
             jax.ShapeDtypeStruct((B, Hq, T, D), q.dtype),
         ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel")),
     )(seed, q, k, v, do, lse, delta)
 
     if G > 1:        # GQA: per-query-head dk/dv -> sum over the group

@@ -138,6 +138,27 @@ def save_checkpoint(ckpt_dir: str, state: Params,
     n_procs = jax.process_count()
     manifest = {"format": _SHARDED_FORMAT, "leaves": [],
                 "metadata": extra_metadata or {}}
+    # post every device->host copy asynchronously BEFORE the write loop:
+    # np.asarray on each shard otherwise serializes one transfer per leaf,
+    # and on a remote-tunnel backend each blocking fetch pays full latency
+    # (r5: a save-every-100-steps run measured ~10x slower than training).
+    # Only the OWNER shards the write loop will actually read are
+    # prefetched — replicas would multiply the transferred bytes by the
+    # local device count for nothing.
+    for _, leaf in leaves:
+        if not isinstance(leaf, jax.Array):
+            continue
+        if n_procs > 1 and leaf.sharding.is_fully_addressable:
+            owners = {min(d.id for d in leaf.sharding.device_set)} \
+                if is_proc0 else set()
+        else:
+            owners = {owner.id for owner, _ in _unique_shards(leaf)}
+        for s in leaf.addressable_shards:
+            if s.device.id in owners and s.device.id in local_ids:
+                try:
+                    s.data.copy_to_host_async()
+                except (AttributeError, RuntimeError):
+                    break
     for i, (path, leaf) in enumerate(leaves):
         leaf = jnp_asarray(leaf)
         shards_meta = []
