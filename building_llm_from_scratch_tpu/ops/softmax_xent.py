@@ -24,6 +24,14 @@ than it saves in logits traffic at this model size — the win here comes
 from the custom backward (no stored log-probs, dlogits feeding matmuls
 directly), so the default is one padded chunk. Smaller chunks remain
 correct and useful when (N, V) temps must be bounded (long-context eval).
+r5 also split ``fwd_chunk`` from the backward chunk (the backward's three
+matmuls run at ~87% MXU and only lose W re-reads from chunking, while the
+forward's fp32 logits temp is pure HBM traffic) — measured in-model:
+fwd_chunk 6400/12800/25600 gave 91.7/93.5/93.2k tok/s vs ~94-98k dense,
+i.e. chunking the forward alone still loses (the scan boundary breaks
+XLA's matmul+exp fusion). Dense stays the default on both sides;
+``BLLM_XENT_FWD_CHUNK`` keeps the forward bound available for
+long-context eval.
 """
 
 from __future__ import annotations
@@ -57,19 +65,59 @@ def _chunk_logits(x2, wp, c, chunk, V):
     return jnp.where(col[None, :] < V, logits, _NEG_BIG)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def softmax_xent(x2: jnp.ndarray,        # (N, D) final hidden states
                  w_head: jnp.ndarray,    # (D, V) untied output head
                  targets: jnp.ndarray,   # (N,) int32
-                 chunk: int = 51200) -> jnp.ndarray:
-    """Per-token negative log-likelihood (N,) fp32."""
-    nll, _ = _xent_fwd_impl(x2, w_head, targets, chunk)
+                 chunk: int = 51200,
+                 fwd_chunk: Optional[int] = None) -> jnp.ndarray:
+    """Per-token negative log-likelihood (N,) fp32.
+
+    ``chunk`` drives the BACKWARD's recompute granularity; ``fwd_chunk``
+    (defaults to ``chunk``) the forward's. They are split because their
+    trade-offs differ: the backward is three near-peak matmuls whose
+    chunking only adds W re-reads, while the forward's live fp32 logits
+    temp (N, fwd_chunk) is pure HBM traffic the online logsumexp can
+    shrink."""
+    nll, _ = _xent_fwd_impl(x2, w_head, targets, fwd_chunk or chunk)
     return nll
+
+
+def _use_pallas_fwd(N, D, V) -> bool:
+    """Opt-in (BLLM_XENT_PALLAS=1): the pallas forward streams the vocab
+    through VMEM so the (N, Vp) fp32 logits temp (1.6GB at GPT2-124M bs8)
+    never exists — but measured DEAD-EVEN on the headline (97.42k vs
+    97.41k tok/s, r5 A/B): XLA overlaps the logits HBM traffic with
+    compute. Kept opt-in for memory-constrained shapes rather than
+    default: it buys HBM headroom, not steady-state speed."""
+    import os
+
+    if os.environ.get("BLLM_XENT_PALLAS", "0") != "1":
+        return False
+    if jax.default_backend() != "tpu" or len(jax.devices()) != 1:
+        # pallas_call is not auto-partitioned by GSPMD: on a sharded mesh
+        # it would force gathering the (N, D)/(D, V) operands, and the
+        # VMEM gate below would be evaluated on GLOBAL shapes anyway —
+        # single-device only (a shard_map wrapper could lift this)
+        return False
+    from building_llm_from_scratch_tpu.ops.xent_fwd_pallas import (
+        supports_shape,
+    )
+
+    return supports_shape(N, D, V)
 
 
 def _xent_fwd_impl(x2, w_head, targets, chunk):
     N, D = x2.shape
     V = w_head.shape[1]
+    if _use_pallas_fwd(N, D, V):
+        # pallas forward (ops/xent_fwd_pallas.py): vocabulary streamed
+        # through VMEM, fp32 logits never reach HBM
+        from building_llm_from_scratch_tpu.ops.xent_fwd_pallas import (
+            xent_fwd,
+        )
+
+        return xent_fwd(x2, w_head, targets)
     wp, n_chunks = _pad_vocab(w_head, chunk)
 
     def body(carry, c):
@@ -93,12 +141,12 @@ def _xent_fwd_impl(x2, w_head, targets, chunk):
     return lse - tl, lse
 
 
-def _xent_fwd(x2, w_head, targets, chunk):
-    nll, lse = _xent_fwd_impl(x2, w_head, targets, chunk)
+def _xent_fwd(x2, w_head, targets, chunk, fwd_chunk):
+    nll, lse = _xent_fwd_impl(x2, w_head, targets, fwd_chunk or chunk)
     return nll, (x2, w_head, targets, lse)
 
 
-def _xent_bwd(chunk, res, g):
+def _xent_bwd(chunk, fwd_chunk, res, g):
     """g: (N,) cotangent of the per-token nll."""
     x2, w_head, targets, lse = res
     N, D = x2.shape
@@ -138,6 +186,13 @@ def _default_chunk() -> int:
     return int(os.environ.get("BLLM_XENT_CHUNK", "51200"))
 
 
+def _default_fwd_chunk() -> Optional[int]:
+    import os
+
+    v = os.environ.get("BLLM_XENT_FWD_CHUNK")
+    return int(v) if v else None
+
+
 def fused_cross_entropy_loss(hidden: jnp.ndarray,      # (B, T, D)
                              w_head: jnp.ndarray,      # (D, V)
                              targets: jnp.ndarray,     # (B, T)
@@ -146,11 +201,15 @@ def fused_cross_entropy_loss(hidden: jnp.ndarray,      # (B, T, D)
     """Weighted token-mean CE — same semantics as
     training.train_step.cross_entropy_loss(forward(...), targets, weights)
     without ever materializing (B, T, V) fp32 logits."""
+    # the env fwd-chunk default applies ONLY when the caller did not pass
+    # an explicit chunk — an explicit bound must always win
+    fwd_chunk = _default_fwd_chunk() if chunk is None else None
     if chunk is None:
         chunk = _default_chunk()
     B, T, D = hidden.shape
     nll = softmax_xent(hidden.reshape(B * T, D), w_head,
-                       targets.reshape(B * T).astype(jnp.int32), chunk)
+                       targets.reshape(B * T).astype(jnp.int32), chunk,
+                       fwd_chunk)
     nll = nll.reshape(B, T)
     if weights is None:
         return jnp.mean(nll)
@@ -162,11 +221,13 @@ def fused_cross_entropy_sums(hidden, w_head, targets, weights,
                              chunk: Optional[int] = None):
     """(weighted nll sum, weight sum) — the cross-shard-psum variant
     (mirrors train_step.cross_entropy_sums)."""
+    fwd_chunk = _default_fwd_chunk() if chunk is None else None
     if chunk is None:
         chunk = _default_chunk()
     B, T, D = hidden.shape
     nll = softmax_xent(hidden.reshape(B * T, D), w_head,
-                       targets.reshape(B * T).astype(jnp.int32), chunk)
+                       targets.reshape(B * T).astype(jnp.int32), chunk,
+                       fwd_chunk)
     nll = nll.reshape(B, T)
     if weights is None:
         weights = jnp.ones_like(nll)
