@@ -21,7 +21,8 @@ import sys
 # pp: data=2 x stage=2) the cross-group deadlock cannot form. The full
 # dp x sp / dp x stage compositions are covered by the in-process parity
 # tests (test_ring_attention.py / test_pipeline.py).
-_N_DEV = {"sp": 2, "pp": 4}.get(sys.argv[1] if len(sys.argv) > 1 else "", 4)
+_N_DEV = {"sp": 2, "pp": 4, "pp_tp": 4}.get(
+    sys.argv[1] if len(sys.argv) > 1 else "", 4)
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "")
     .replace("--xla_force_host_platform_device_count=8", "").strip()
@@ -61,6 +62,25 @@ def main():
         assert trainer.plan.n_stages == 2
         wq = trainer.state["trainable"]["blocks"]["attn"]["wq"]
         assert len(wq.sharding.device_set) == 4  # (data=2, stage=2)
+    elif mode == "pp_tp":
+        # pipeline x Megatron tp from the CLI (round-5 VERDICT #6):
+        # (data=1, stage=2, model=2) on 4 virtual devices. NOTE: this
+        # mode interleaves TWO collective families (stage ppermute +
+        # per-layer model psums) — it relies on the parent's retry loop
+        # if the rare CPU-runtime rendezvous abort ever hits it, unlike
+        # sp/pp whose device counts keep a single family.
+        args = get_args(base + ["--shard_mode", "pp", "--pp", "2",
+                                "--tp", "2", "--pp_micro", "2"])
+        trainer = run_main(args)
+        assert trainer.plan.shard_mode == "pp"
+        assert trainer.plan.n_stages == 2 and trainer.plan.n_tp == 2
+        wq = trainer.state["trainable"]["blocks"]["attn"]["wq"]
+        # really tp-sharded: model axis in the spec AND a halved local
+        # shard on the head axis (device_set alone cannot tell sharded
+        # from replicated on this mesh)
+        assert "model" in str(wq.sharding.spec), wq.sharding.spec
+        assert wq.addressable_shards[0].data.shape[-1] == wq.shape[-1] // 2
+        assert len(wq.sharding.device_set) == 4  # stage x model
     else:
         raise SystemExit(f"unknown mode {mode}")
     assert trainer.global_step > 0
