@@ -574,8 +574,9 @@ def forward_with_cache(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     # copies XLA inserts on the while-loop carry, but measured 3% SLOWER
     # end-to-end on GPT2-124M bs8 (690 vs 715 tok/s/seq, r5 A/B x3): its
     # per-batch-row grid serializes attention panes the XLA path overlaps
-    # with the surrounding weight streams. Kept for GQA shapes / future
-    # tuning; default off.
+    # with the surrounding weight streams. On GQA (LLaMA3.2-1B bs8) the
+    # A/B is dead-even (224.1 vs 224.4 tok/s/seq — weight streaming
+    # dominates at 1B). Kept for future tuning; default off.
     use_fused_step = False
     if (jax.default_backend() == "tpu"
             and _os.environ.get("BLLM_FUSED_DECODE", "0") == "1"):
