@@ -11,7 +11,9 @@ re-targeted at TPU hardware:
     (datautils/mixed_precision.py:41-46) incl. bf16_hybrid;
   - TPU/offline additions: --tokenizer_path, --weights_dir,
     --byte_tokenizer, --tp, --target_context_length, --resume_from,
-    --profile, --seed.
+    --profile, --seed;
+  - fault tolerance (training/resilience.py): --resume auto|off|<dir>,
+    --keep_ckpts, --watchdog/--loss_spike_factor/--watchdog_window.
 """
 
 from __future__ import annotations
@@ -176,6 +178,16 @@ def perform_checks(args) -> None:
     if args.resume_from is not None and not os.path.isdir(args.resume_from):
         raise FileNotFoundError(
             f"--resume_from checkpoint '{args.resume_from}' does not exist.")
+    if args.resume not in ("auto", "off") and not os.path.isdir(args.resume):
+        raise FileNotFoundError(
+            f"--resume checkpoint '{args.resume}' does not exist "
+            "(expected 'auto', 'off', or a checkpoint directory).")
+    if args.keep_ckpts < 0:
+        raise ValueError("--keep_ckpts must be >= 0 (0 keeps all).")
+    if args.loss_spike_factor <= 1.0:
+        raise ValueError("--loss_spike_factor must be > 1.")
+    if args.watchdog_window < 1:
+        raise ValueError("--watchdog_window must be >= 1.")
     if args.init_params_from is not None:
         if args.load_weights:
             raise ValueError(
@@ -320,9 +332,35 @@ def get_args(argv=None):
                         help="Fall back to the offline ByteTokenizer "
                              "(debug/smoke runs).")
 
-    # Run management
+    # Run management / fault tolerance
     parser.add_argument("--resume_from", type=str, default=None,
                         help="Resume training from a checkpoint directory.")
+    parser.add_argument("--resume", type=str, default="auto",
+                        help="'auto' (default): resume from the latest "
+                             "VALID checkpoint in --output_dir (manifest + "
+                             "per-shard size/sha256 checks; corrupt "
+                             "checkpoints fall back to the previous valid "
+                             "one) — a preempted job relaunches with its "
+                             "original command; 'off': always start fresh; "
+                             "or an explicit checkpoint dir.")
+    parser.add_argument("--keep_ckpts", type=int, default=0,
+                        help="Retention GC: keep at most N step-tagged "
+                             "checkpoints (model_pg_<step>), pruning the "
+                             "oldest after each save. 'interrupted'/'final' "
+                             "checkpoints are never pruned. 0 keeps all.")
+    parser.add_argument("--watchdog", type=str, default="on",
+                        choices=["on", "off"],
+                        help="Loss anomaly watchdog: halt with a diagnostic "
+                             "on non-finite train loss or a spike above "
+                             "--loss_spike_factor x the running median "
+                             "(bf16/fp32 runs; fp16 already skips bad steps "
+                             "via loss scaling).")
+    parser.add_argument("--loss_spike_factor", type=float, default=10.0,
+                        help="Watchdog spike threshold as a multiple of the "
+                             "running median train loss.")
+    parser.add_argument("--watchdog_window", type=int, default=50,
+                        help="Steps in the watchdog's running-median "
+                             "window.")
     parser.add_argument("--profile", action="store_true",
                         help="Capture a jax.profiler trace of the first "
                              "training steps into <output_dir>/profile.")

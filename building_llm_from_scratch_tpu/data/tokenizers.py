@@ -195,8 +195,14 @@ def fetch_tokenizer_asset(model: str,
     repo_id, filename = HF_TOKENIZER_ASSETS[model]
     from huggingface_hub import hf_hub_download
 
-    return hf_hub_download(repo_id=repo_id, filename=filename,
-                           cache_dir=cache_dir)
+    from building_llm_from_scratch_tpu.utils.retry import with_retries
+
+    # bounded retry (3 attempts, backoff + jitter): transient hub failures
+    # recover; 404/gated errors re-raise immediately (utils/retry.py)
+    return with_retries(
+        lambda: hf_hub_download(repo_id=repo_id, filename=filename,
+                                cache_dir=cache_dir),
+        describe=f"download {repo_id}/{filename}")
 
 
 def build_tokenizer(model: str, tokenizer_path: Optional[str] = None,

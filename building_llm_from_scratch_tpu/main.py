@@ -14,7 +14,11 @@ TPU-first differences from the reference:
   - run artifacts (losses.pdf, peak memory, final export) are written by
     the coordinator process (the reference's ``rank == 0`` gating);
   - ``--resume_from`` restores params + optimizer state + step — a path
-    the reference lacks entirely (SURVEY §5);
+    the reference lacks entirely (SURVEY §5) — and ``--resume auto``
+    (default) discovers the latest valid checkpoint in ``--output_dir``
+    so a preempted job relaunches with its original command; SIGTERM/
+    SIGINT checkpoint at the next step boundary and exit 0
+    (training/resilience.py);
   - ``--profile`` captures a jax.profiler trace of the first steps.
 
 Usage:  python -m building_llm_from_scratch_tpu --data_dir ... [flags]
@@ -34,6 +38,11 @@ from building_llm_from_scratch_tpu.parallel import (
     initialize_distributed,
     is_coordinator,
     sync_global_devices,
+)
+from building_llm_from_scratch_tpu.training.resilience import (
+    GracefulStopper,
+    LossWatchdog,
+    resolve_resume_agreed,
 )
 from building_llm_from_scratch_tpu.training.trainer import Trainer
 from building_llm_from_scratch_tpu.utils.io import discover_training_files
@@ -99,6 +108,18 @@ def main(args) -> Trainer:
         os.makedirs(args.output_dir, exist_ok=True)
     sync_global_devices("output_dir")
 
+    # 5b. fault tolerance: auto-resume discovery (coordinator-resolved and
+    #     shared via the output dir so every host restores the SAME
+    #     checkpoint), loss watchdog, and the graceful-stop signal handler
+    resume_from = resolve_resume_agreed(getattr(args, "resume", "auto"),
+                                        args.resume_from, args.output_dir)
+    watchdog = None
+    if getattr(args, "watchdog", "on") == "on" and not (
+            comps.policy is not None and comps.policy.name == "fp16"):
+        watchdog = LossWatchdog(spike_factor=args.loss_spike_factor,
+                                window=args.watchdog_window)
+    stopper = GracefulStopper()
+
     # 6. trainer (reference main.py:122-138); the warm-up sample
     #    (main.py:143-145) runs inside the trainer once state exists
     trainer = Trainer(
@@ -114,18 +135,35 @@ def main(args) -> Trainer:
         lora_rank=args.lora_rank if args.use_lora else None,
         policy=comps.policy, plan=comps.plan, seed=args.seed,
         grad_accum=args.grad_accum,
-        resume_from=args.resume_from,
+        resume_from=resume_from,
         warmup_sample=True,
         profile_dir=(os.path.join(args.output_dir, "profile")
                      if args.profile else None),
         profile_steps=args.profile_steps,
+        keep_ckpts=args.keep_ckpts,
+        watchdog=watchdog,
+        stopper=stopper,
     )
 
-    # 7. train / finetune (reference main.py:150-157)
-    if args.finetune:
-        trainer.finetune_model(files, n_epochs=args.n_epochs)
-    else:
-        trainer.train_model(files, n_epochs=args.n_epochs)
+    # 7. train / finetune (reference main.py:150-157) under the graceful-
+    #    stop handler: SIGTERM (preemption) / SIGINT checkpoint at the next
+    #    step boundary and fall through here with trainer.preempted set
+    with stopper:
+        if args.finetune:
+            trainer.finetune_model(files, n_epochs=args.n_epochs)
+        else:
+            trainer.train_model(files, n_epochs=args.n_epochs)
+
+    if trainer.preempted:
+        # the interrupted checkpoint is on disk; skip the final export so
+        # the process exits 0 within the preemption grace window — the
+        # relaunch picks the run back up via --resume auto
+        logger.warning(
+            "Run preempted at step %d; interrupted checkpoint written. "
+            "Relaunch the same command to resume (--resume auto).",
+            trainer.global_step)
+        sync_global_devices("run_end")
+        return trainer
 
     # 8. plot + peak memory on the coordinator (reference main.py:162-166)
     if is_coordinator():

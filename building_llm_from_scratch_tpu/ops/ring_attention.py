@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from building_llm_from_scratch_tpu.parallel.collectives import shard_map
 from building_llm_from_scratch_tpu.parallel.mesh import DATA_AXIS, SEQ_AXIS
 
 _NEG_INF = -1e30
@@ -158,9 +159,9 @@ def ring_causal_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                              dropout_rate=dropout_rate,
                              shard_fold_axes=fold_axes)
     if dropout_rate > 0.0 and dropout_rng is not None:
-        return jax.shard_map(
+        return shard_map(
             lambda q, k, v, r: body(q, k, v, dropout_rng=r),
             mesh=mesh, in_specs=(spec, spec, spec, P()),
             out_specs=spec, check_vma=False)(q, k, v, dropout_rng)
-    return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+    return shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, check_vma=False)(q, k, v)

@@ -49,6 +49,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from building_llm_from_scratch_tpu.configs import ModelConfig
+from building_llm_from_scratch_tpu.parallel.collectives import shard_map
 from building_llm_from_scratch_tpu.models.transformer import (
     _block,
     _embed,
@@ -354,7 +355,7 @@ def make_pp_loss_fn(cfg: ModelConfig, mesh: Mesh, n_micro: int
         blk_specs = _stage_block_specs(stage_blocks, n_tp)
         mb_spec = P(None, DATA_AXIS)   # each data column pipelines its rows
         if rng is not None and cfg.drop_rate > 0.0:
-            fn = jax.shard_map(
+            fn = shard_map(
                 pp_body,
                 mesh=mesh,
                 in_specs=(rep, blk_specs, mb_spec, mb_spec, mb_spec,
@@ -363,7 +364,7 @@ def make_pp_loss_fn(cfg: ModelConfig, mesh: Mesh, n_micro: int
                 check_vma=False,
             )
             return fn(other, stage_blocks, inputs, targets, weights, rng)
-        fn = jax.shard_map(
+        fn = shard_map(
             lambda p, b, i, t, w: pp_body(p, b, i, t, w, None),
             mesh=mesh,
             in_specs=(rep, blk_specs, mb_spec, mb_spec, mb_spec),

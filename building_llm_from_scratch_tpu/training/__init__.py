@@ -25,6 +25,16 @@ from building_llm_from_scratch_tpu.training.checkpoint import (
     save_checkpoint,
     save_checkpoint_gathered,
 )
+from building_llm_from_scratch_tpu.training.resilience import (
+    GracefulStopper,
+    LossWatchdog,
+    PreemptionStop,
+    TrainingDivergedError,
+    find_latest_valid_checkpoint,
+    prune_checkpoints,
+    resolve_resume,
+    validate_checkpoint,
+)
 from building_llm_from_scratch_tpu.training.trainer import Trainer
 
 __all__ = [
@@ -45,5 +55,13 @@ __all__ = [
     "load_exported_params",
     "save_checkpoint",
     "save_checkpoint_gathered",
+    "GracefulStopper",
+    "LossWatchdog",
+    "PreemptionStop",
+    "TrainingDivergedError",
+    "find_latest_valid_checkpoint",
+    "prune_checkpoints",
+    "resolve_resume",
+    "validate_checkpoint",
     "Trainer",
 ]

@@ -20,6 +20,18 @@ the full design the reference lacks while keeping its export semantics:
   - ``export_params`` / ``load_exported_params``: a single ``.npz`` of just
     the model params, gathered to process 0 — the analog of the reference's
     final ``model_pg_final.pth`` full-state-dict export (main.py:171-172).
+
+Manifest integrity fields (fault-tolerance round): each shard entry in
+``manifest["leaves"][i]["shards"]`` additionally records ``bytes`` (file
+size) and ``sha256`` (content hash), computed by process 0 after the
+all-shards barrier and before the manifest is committed. They are what
+``training/resilience.validate_checkpoint`` checks so ``--resume auto``
+can reject truncated/bit-rotted checkpoints and fall back to the previous
+valid one. Manifests written before this round (no checksum fields) still
+load and validate on shard existence alone. ``manifest["metadata"]`` may
+also carry a ``cursor`` dict (epoch, file_index, batch_index) written by
+the Trainer so resume fast-forwards the deterministic shuffled loader to
+the exact mid-epoch position.
 """
 
 from __future__ import annotations
@@ -94,6 +106,50 @@ def _unique_shards(leaf):
     return out
 
 
+def sha256_file(path: str, chunk: int = 1 << 20) -> str:
+    """Chunked file hash — the single implementation shared by the save
+    path (recording) and resilience.validate_checkpoint (verifying)."""
+    import hashlib
+
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(chunk), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+class _HashingWriter:
+    """File-object tee: forwards writes while folding the exact bytes into
+    a sha256. No ``fileno`` on purpose — numpy then streams the array
+    through ``write()`` in chunks, so hashing adds NO extra array copy and
+    the save path keeps its peak-host-memory-is-one-shard contract."""
+
+    def __init__(self, f):
+        import hashlib
+
+        self._f = f
+        self._h = hashlib.sha256()
+        self.nbytes = 0
+
+    def write(self, data):
+        self._h.update(data)
+        self.nbytes += len(data)
+        return self._f.write(data)
+
+    def hexdigest(self) -> str:
+        return self._h.hexdigest()
+
+
+def _write_shard_hashed(path: str, arr: np.ndarray):
+    """np.save through a hashing tee — locally-written shards get their
+    integrity record for free instead of a full read-back at manifest
+    time. Returns (nbytes, sha256hex)."""
+    with open(path, "wb") as f:
+        w = _HashingWriter(f)
+        np.save(w, arr)
+    return w.nbytes, w.hexdigest()
+
+
 def _barrier(name: str) -> None:
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
@@ -159,6 +215,7 @@ def save_checkpoint(ckpt_dir: str, state: Params,
                     s.data.copy_to_host_async()
                 except (AttributeError, RuntimeError):
                     break
+    local_hashes: Dict[str, tuple] = {}      # fname -> (bytes, sha256)
     for i, (path, leaf) in enumerate(leaves):
         leaf = jnp_asarray(leaf)
         shards_meta = []
@@ -173,7 +230,8 @@ def save_checkpoint(ckpt_dir: str, state: Params,
                 "file": fname,
                 "index": [[0, d] for d in leaf.shape]})
             if is_proc0:
-                np.save(os.path.join(tmp_dir, fname), np.asarray(leaf))
+                local_hashes[fname] = _write_shard_hashed(
+                    os.path.join(tmp_dir, fname), np.asarray(leaf))
         else:
             by_device = {s.device.id: s for s in leaf.addressable_shards}
             for k, (owner, index_key) in enumerate(_unique_shards(leaf)):
@@ -181,8 +239,11 @@ def save_checkpoint(ckpt_dir: str, state: Params,
                 shards_meta.append({"file": fname,
                                     "index": [list(se) for se in index_key]})
                 if owner.id in local_ids:
-                    np.save(os.path.join(tmp_dir, fname),
-                            np.asarray(by_device[owner.id].data))
+                    nb, hx = _write_shard_hashed(
+                        os.path.join(tmp_dir, fname),
+                        np.asarray(by_device[owner.id].data))
+                    if is_proc0:
+                        local_hashes[fname] = (nb, hx)
         manifest["leaves"].append({
             "index": i,
             "path": _path_str(path),
@@ -195,6 +256,21 @@ def save_checkpoint(ckpt_dir: str, state: Params,
     if is_proc0:
         import shutil
 
+        # integrity records for resilience.validate_checkpoint: every shard
+        # gets its size + sha256 into the manifest BEFORE the commit
+        # rename, so a truncated or bit-flipped file is detectable at
+        # resume time. Shards this process wrote were hashed at write time;
+        # only shards OTHER hosts wrote (on the shared filesystem, complete
+        # per the barrier above) need a read-back — zero extra I/O on
+        # single-host runs.
+        for leaf_meta in manifest["leaves"]:
+            for sh in leaf_meta["shards"]:
+                if sh["file"] in local_hashes:
+                    sh["bytes"], sh["sha256"] = local_hashes[sh["file"]]
+                else:
+                    spath = os.path.join(tmp_dir, sh["file"])
+                    sh["bytes"] = os.path.getsize(spath)
+                    sh["sha256"] = sha256_file(spath)
         with open(os.path.join(tmp_dir, "manifest.json"), "w") as f:
             json.dump(manifest, f, indent=1)
         old_dir = None
@@ -304,6 +380,53 @@ def _resolve_ckpt_dir(ckpt_dir: str) -> str:
     return ckpt_dir
 
 
+def _cleanup_stale_siblings(ckpt_dir: str) -> None:
+    """Remove ``.tmp``/``.old`` staging dirs orphaned by a crashed save.
+
+    Only called once the tag itself resolved (its manifest exists), so the
+    siblings are by definition leftovers, not the recovery copy. Process 0
+    only — peers resolve the committed tag and never read the orphans."""
+    import jax as _jax
+
+    if _jax.process_index() != 0:
+        return
+    import shutil
+
+    for suffix in (".tmp", ".old"):
+        cand = ckpt_dir.rstrip("/") + suffix
+        if os.path.isdir(cand):
+            logger.warning(
+                "Removing orphaned checkpoint staging dir %s (left by a "
+                "crashed save).", cand)
+            shutil.rmtree(cand, ignore_errors=True)
+
+
+def _read_manifest(ckpt_dir: str) -> dict:
+    """Read + structurally check a checkpoint manifest, raising ONE clear
+    ``ValueError`` naming the dir and what is missing/malformed instead of
+    a raw ``FileNotFoundError``/``KeyError``/``JSONDecodeError``."""
+    manifest_path = os.path.join(ckpt_dir, "manifest.json")
+    if not os.path.isfile(manifest_path):
+        raise ValueError(
+            f"'{ckpt_dir}' is not a readable checkpoint: manifest.json is "
+            "missing (not a checkpoint directory, or the save died before "
+            "its commit and left no recoverable .tmp/.old staging dir).")
+    try:
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+    except (json.JSONDecodeError, UnicodeDecodeError, OSError) as e:
+        raise ValueError(
+            f"Checkpoint manifest {manifest_path} is malformed "
+            f"({type(e).__name__}: {e}); the checkpoint cannot be "
+            "restored.") from e
+    if not isinstance(manifest, dict) or not isinstance(
+            manifest.get("leaves"), list):
+        raise ValueError(
+            f"Checkpoint manifest {manifest_path} is malformed: expected a "
+            "JSON object with a 'leaves' list.")
+    return manifest
+
+
 def load_checkpoint(ckpt_dir: str, template_state: Params,
                     shardings: Optional[Params] = None) -> Params:
     """Restore a checkpoint into the structure of ``template_state``.
@@ -318,9 +441,11 @@ def load_checkpoint(ckpt_dir: str, template_state: Params,
     Handles both the sharded-v1 format and the round-3 gathered format
     (full ``leaf_NNNNN.npy`` files).
     """
-    ckpt_dir = _resolve_ckpt_dir(ckpt_dir)
-    with open(os.path.join(ckpt_dir, "manifest.json")) as f:
-        manifest = json.load(f)
+    resolved = _resolve_ckpt_dir(ckpt_dir)
+    if resolved == ckpt_dir:
+        _cleanup_stale_siblings(ckpt_dir)
+    ckpt_dir = resolved
+    manifest = _read_manifest(ckpt_dir)
     sharded = manifest.get("format") == _SHARDED_FORMAT
     flat, treedef = jax.tree_util.tree_flatten_with_path(template_state)
     if len(flat) != len(manifest["leaves"]):
@@ -387,9 +512,7 @@ def load_checkpoint(ckpt_dir: str, template_state: Params,
 
 
 def checkpoint_metadata(ckpt_dir: str) -> dict:
-    with open(os.path.join(_resolve_ckpt_dir(ckpt_dir),
-                           "manifest.json")) as f:
-        return json.load(f)["metadata"]
+    return _read_manifest(_resolve_ckpt_dir(ckpt_dir)).get("metadata", {})
 
 
 def export_params(path: str, params: Params) -> str:

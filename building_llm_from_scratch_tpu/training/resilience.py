@@ -1,0 +1,376 @@
+"""Fault-tolerance subsystem: preemption-safe stop, checkpoint integrity,
+auto-resume discovery, retention GC, and a loss watchdog.
+
+A real TPU run dies to preemption, not Ctrl-C: v5e capacity is routinely
+preemptible, and spot economics only work if a killed worker loses seconds,
+not epochs. This module supplies the pieces the trainer wires together:
+
+  - ``GracefulStopper``: SIGTERM/SIGINT set a flag; the trainer polls it at
+    step boundaries, writes a final checkpoint, and returns cleanly (exit 0).
+    Multi-host safe: the signal is observed locally but the stop decision is
+    agreed globally (all-reduce OR over processes), so no host bails out of
+    a step loop while its peers block in a collective.
+  - ``validate_checkpoint`` / ``find_latest_valid_checkpoint``: integrity
+    checks over the manifest's per-shard ``bytes``/``sha256`` records
+    (written by ``save_checkpoint`` since this round; manifests without them
+    still validate on existence alone). Auto-resume walks checkpoints
+    newest-first and falls back — loudly — past corrupt ones.
+  - ``resolve_resume``: the ``--resume auto|off|<dir>`` policy. ``auto``
+    discovers the latest valid checkpoint under ``output_dir`` so a
+    relaunched preempted job needs no hand-typed path.
+  - ``prune_checkpoints``: retention GC for ``--keep_ckpts N`` — only
+    step-tagged ``model_pg_<step>`` dirs are eligible; ``interrupted`` and
+    ``final`` checkpoints and the newest N survive.
+  - ``LossWatchdog``: running-median spike / non-finite detection for
+    bf16/fp32 runs (fp16 already skips bad steps via loss scaling) — halts
+    with a diagnostic instead of training on a diverged model for hours.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+import signal
+from collections import deque
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from building_llm_from_scratch_tpu.utils.logging import setup_logger
+
+logger = setup_logger(__name__)
+
+#: Prefix shared by every Trainer-written checkpoint dir (model_pg_<tag>).
+CKPT_PREFIX = "model_pg_"
+
+_STEP_TAGGED = re.compile(r"^" + re.escape(CKPT_PREFIX) + r"(\d+)$")
+
+
+class PreemptionStop(Exception):
+    """Raised by the trainer at a step boundary after a graceful-stop
+    request; callers treat it as a clean early return, not a failure."""
+
+
+class TrainingDivergedError(RuntimeError):
+    """Raised by ``LossWatchdog`` on non-finite or spiking train loss."""
+
+
+# ---------------------------------------------------------------------------
+# Graceful stop (SIGTERM/SIGINT -> stop at the next step boundary)
+# ---------------------------------------------------------------------------
+
+class GracefulStopper:
+    """Context manager that converts SIGTERM/SIGINT into a polled flag.
+
+    Inside the context the first signal only records the request — the
+    training loop finishes its current step, writes a checkpoint, and
+    returns. A second SIGINT raises ``KeyboardInterrupt`` (the impatient
+    Ctrl-C Ctrl-C escape hatch). Handlers are restored on exit; when not
+    running in the main thread (where ``signal.signal`` is illegal) the
+    stopper degrades to a plain never-set flag.
+    """
+
+    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT),
+                 sync_every: int = 8):
+        self._signals = signals
+        self._previous = {}
+        self._calls = 0
+        self._sigint_seen = False
+        self.sync_every = max(1, sync_every)
+        self.requested = False
+
+    def _handle(self, signum, frame):
+        # only a SECOND Ctrl-C aborts: a SIGINT after a SIGTERM (operator
+        # watching a preemption drain) must not degrade the in-progress
+        # graceful stop into the best-effort abort path
+        if signum == signal.SIGINT:
+            if self._sigint_seen:
+                raise KeyboardInterrupt
+            self._sigint_seen = True
+        self.requested = True
+        logger.warning(
+            "Received %s: will checkpoint and stop at the next step "
+            "boundary (send SIGINT again to abort immediately).",
+            signal.Signals(signum).name)
+
+    def __enter__(self) -> "GracefulStopper":
+        for s in self._signals:
+            try:
+                self._previous[s] = signal.signal(s, self._handle)
+            except ValueError:          # not the main thread
+                logger.warning(
+                    "Cannot install %s handler outside the main thread; "
+                    "graceful stop disabled for it.", signal.Signals(s).name)
+        return self
+
+    def __exit__(self, *exc):
+        for s, prev in self._previous.items():
+            signal.signal(s, prev)
+        self._previous.clear()
+        return False
+
+    def should_stop(self) -> bool:
+        """Global stop decision: OR of every process's local flag.
+
+        All processes must call this the same number of times — the trainer
+        calls it exactly once per step, which every host executes in
+        lockstep. Multi-host, the agreement collective only runs every
+        ``sync_every`` calls (a blocking per-step allgather would serialize
+        hosts to the slowest one on every step); between sync points this
+        returns False even if the local flag is set, so no host ever stops
+        without its peers — the stop lands at most sync_every-1 steps late,
+        well inside any preemption grace window.
+        """
+        import jax
+
+        if jax.process_count() == 1:
+            return self.requested
+        self._calls += 1
+        if self._calls % self.sync_every:
+            return False
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(
+            np.asarray([self.requested], dtype=np.int32))
+        return bool(np.max(flags))
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint integrity + discovery
+# ---------------------------------------------------------------------------
+
+def validate_checkpoint(ckpt_dir: str) -> Optional[str]:
+    """Integrity-check one checkpoint. Returns None when valid, else a
+    human-readable reason — it NEVER raises, because its whole purpose is
+    letting ``--resume auto`` fall back past corrupt checkpoints.
+
+    Validity = the manifest parses (``checkpoint._read_manifest``) AND
+    every shard file referenced by it exists with the recorded size and
+    sha256. Manifests written before checksums were recorded (no
+    ``bytes``/``sha256`` fields) validate on existence alone — old
+    checkpoints stay readable.
+    """
+    from building_llm_from_scratch_tpu.training.checkpoint import (
+        _read_manifest,
+        _resolve_ckpt_dir,
+        sha256_file,
+    )
+
+    resolved = _resolve_ckpt_dir(ckpt_dir)
+    try:
+        manifest = _read_manifest(resolved)
+        for meta in manifest["leaves"]:
+            shards = meta.get("shards")
+            if shards is None:
+                # round-3 gathered format: one full .npy per leaf
+                shards = [{"file": f"leaf_{meta.get('index', 0):05d}.npy"}]
+            for sh in shards:
+                path = os.path.join(resolved, sh["file"])
+                if not os.path.isfile(path):
+                    return f"shard file {sh['file']} is missing"
+                if "bytes" in sh:
+                    size = os.path.getsize(path)
+                    if size != int(sh["bytes"]):
+                        return (f"shard file {sh['file']} is {size} bytes, "
+                                f"manifest records {sh['bytes']} "
+                                "(truncated?)")
+                if "sha256" in sh and sha256_file(path) != sh["sha256"]:
+                    return f"shard file {sh['file']} fails its sha256 checksum"
+    except (ValueError, KeyError, TypeError, AttributeError, OSError) as e:
+        # structurally-corrupt manifests (leaves entries that aren't dicts,
+        # shard entries missing 'file', ...) are just another invalid shape
+        return f"manifest is unusable ({type(e).__name__}: {e})"
+    return None
+
+
+def list_checkpoints(output_dir: str) -> List[Tuple[int, str]]:
+    """All Trainer checkpoints under ``output_dir`` as (step, path), path
+    being the commit tag (``.tmp``/``.old`` recovery is handled inside the
+    checkpoint reader). Unreadable entries are skipped with a log line.
+    """
+    from building_llm_from_scratch_tpu.training.checkpoint import (
+        checkpoint_metadata,
+    )
+
+    if not os.path.isdir(output_dir):
+        return []
+    tags = set()
+    for name in sorted(os.listdir(output_dir)):
+        if not name.startswith(CKPT_PREFIX):
+            continue
+        if not os.path.isdir(os.path.join(output_dir, name)):
+            continue                     # e.g. model_pg_final.npz export
+        for suffix in (".tmp", ".old"):
+            if name.endswith(suffix):
+                # a save preempted mid-commit may have left ONLY the staging
+                # dir; resolve through the base tag
+                name = name[: -len(suffix)]
+                break
+        tags.add(name)
+    out = []
+    for name in sorted(tags):
+        path = os.path.join(output_dir, name)
+        try:
+            meta = checkpoint_metadata(path)
+            out.append((int(meta.get("global_step", 0)), path))
+        except (ValueError, OSError) as e:
+            logger.warning("Skipping unreadable checkpoint %s: %s", path, e)
+    return sorted(out)
+
+
+def find_latest_valid_checkpoint(output_dir: str) -> Optional[str]:
+    """The newest checkpoint (by recorded global_step) that passes
+    ``validate_checkpoint``. Corrupt candidates are logged LOUDLY and
+    skipped, so a truncated latest checkpoint falls back to the previous
+    valid one instead of crashing the resume."""
+    for step, path in reversed(list_checkpoints(output_dir)):
+        reason = validate_checkpoint(path)
+        if reason is None:
+            return path
+        logger.error(
+            "Checkpoint %s (step %d) is INVALID: %s — falling back to the "
+            "previous checkpoint.", path, step, reason)
+    return None
+
+
+def resolve_resume(resume: Optional[str], resume_from: Optional[str],
+                   output_dir: str) -> Optional[str]:
+    """Turn the (--resume, --resume_from) flag pair into a checkpoint dir
+    (or None for a fresh start).
+
+    ``--resume_from <dir>`` keeps its historical meaning and wins outright.
+    ``--resume auto`` (the default) discovers the latest valid checkpoint
+    under ``output_dir`` — a relaunched preempted job resumes with the
+    exact command that started it. ``--resume off`` forces a fresh start;
+    any other value is taken as an explicit checkpoint dir.
+    """
+    if resume_from is not None:
+        return resume_from
+    if resume is None or resume == "off":
+        return None
+    if resume != "auto":
+        return resume
+    found = find_latest_valid_checkpoint(output_dir)
+    if found is not None:
+        logger.info("--resume auto: found checkpoint %s", found)
+    return found
+
+
+def resolve_resume_agreed(resume: Optional[str], resume_from: Optional[str],
+                          output_dir: str) -> Optional[str]:
+    """Multi-host-safe ``resolve_resume``: the coordinator alone runs the
+    discovery + validation pass (one full-checkpoint hash read instead of
+    one per host) and shares its choice through a marker file on the shared
+    filesystem, bracketed by barriers — independent per-host discovery
+    could pick DIFFERENT checkpoints if one host races a still-landing or
+    transiently-unreadable shard, and divergent restores deadlock in the
+    load collectives. ``output_dir`` must already exist on every host."""
+    import jax
+
+    if jax.process_count() == 1:
+        return resolve_resume(resume, resume_from, output_dir)
+    from building_llm_from_scratch_tpu.parallel.collectives import (
+        sync_global_devices,
+    )
+
+    marker = os.path.join(output_dir, ".resume_choice")
+    if jax.process_index() == 0:
+        choice = resolve_resume(resume, resume_from, output_dir)
+        with open(marker, "w") as f:
+            f.write(choice or "")
+    sync_global_devices("resume_choice_written")
+    with open(marker) as f:
+        choice = f.read() or None
+    sync_global_devices("resume_choice_read")
+    if jax.process_index() == 0:
+        os.remove(marker)
+    return choice
+
+
+# ---------------------------------------------------------------------------
+# Retention GC
+# ---------------------------------------------------------------------------
+
+def prune_checkpoints(output_dir: str, keep: int) -> List[str]:
+    """Delete the oldest step-tagged checkpoints, keeping the newest
+    ``keep``. Only ``model_pg_<step>`` dirs are eligible — ``interrupted``
+    and ``final`` tags are never touched, and the newest checkpoint (the
+    one just written) is always within the kept set. Returns removed paths.
+
+    Call on ONE process only (the coordinator): deletion is not a
+    collective, and the pruned dirs are by construction ones nobody reads.
+    """
+    if keep < 1:
+        raise ValueError(f"keep must be >= 1, got {keep}")
+    if not os.path.isdir(output_dir):
+        return []
+    tagged = []
+    for name in os.listdir(output_dir):
+        m = _STEP_TAGGED.match(name)
+        if m and os.path.isdir(os.path.join(output_dir, name)):
+            tagged.append((int(m.group(1)), name))
+    removed = []
+    for step, name in sorted(tagged)[:-keep]:
+        for suffix in ("", ".tmp", ".old"):
+            path = os.path.join(output_dir, name + suffix)
+            if os.path.isdir(path):
+                shutil.rmtree(path, ignore_errors=True)
+                if not suffix:
+                    removed.append(path)
+    if removed:
+        logger.info("Retention GC: removed %d old checkpoint(s): %s",
+                    len(removed), ", ".join(os.path.basename(p)
+                                            for p in removed))
+    return removed
+
+
+# ---------------------------------------------------------------------------
+# Loss watchdog
+# ---------------------------------------------------------------------------
+
+class LossWatchdog:
+    """Halt on silent divergence: non-finite train loss, or a spike above
+    ``spike_factor`` x the running median of the last ``window`` steps.
+
+    Intended for bf16/fp32 runs — fp16 policies already skip non-finite
+    steps via dynamic loss scaling, so the trainer does not attach a
+    watchdog there. The spike check arms only after ``min_history``
+    observations so noisy warmup steps cannot trip it.
+    """
+
+    def __init__(self, spike_factor: float = 10.0, window: int = 50,
+                 min_history: int = 20, check_finite: bool = True):
+        if spike_factor <= 1.0:
+            raise ValueError(
+                f"spike_factor must be > 1, got {spike_factor}")
+        self.spike_factor = spike_factor
+        # the history deque caps at `window`, so an arming threshold above
+        # it could never be reached and the spike check would be silently
+        # dead (e.g. --watchdog_window 10 with the default min_history 20)
+        self.min_history = min(min_history, window)
+        self.check_finite = check_finite
+        self._history: deque = deque(maxlen=window)
+
+    def observe(self, step: int, loss: float) -> None:
+        if self.check_finite and not np.isfinite(loss):
+            raise TrainingDivergedError(
+                f"Train loss became non-finite ({loss}) by step {step}. "
+                f"Recent losses: {self._tail()}. The model has diverged — "
+                "lower the learning rate, raise warmup, or resume from an "
+                "earlier checkpoint.")
+        if len(self._history) >= self.min_history:
+            median = float(np.median(self._history))
+            if np.isfinite(loss) and loss > self.spike_factor * max(
+                    median, 1e-8):
+                raise TrainingDivergedError(
+                    f"Train loss {loss:.4f} at step {step} spiked above "
+                    f"{self.spike_factor:g}x the running median "
+                    f"{median:.4f} (window={self._history.maxlen}). Recent "
+                    f"losses: {self._tail()}. Halting instead of training "
+                    "on a diverged model; resume from an earlier checkpoint "
+                    "with a lower LR.")
+        self._history.append(float(loss))
+
+    def _tail(self, n: int = 8) -> List[float]:
+        return [round(x, 4) for x in list(self._history)[-n:]]

@@ -356,6 +356,7 @@ def make_sharded_train_step(cfg: ModelConfig,
     """
     from jax.sharding import PartitionSpec as P
 
+    from building_llm_from_scratch_tpu.parallel.collectives import shard_map
     from building_llm_from_scratch_tpu.parallel.mesh import (
         DATA_AXIS,
         SEQ_AXIS,
@@ -481,7 +482,7 @@ def make_sharded_train_step(cfg: ModelConfig,
         scalars = {"rng": state["rng"], "step": state["step"]}
         if "loss_scale" in state:
             scalars["loss_scale"] = state["loss_scale"]
-        sharded_grads = jax.shard_map(
+        sharded_grads = shard_map(
             make_body(t_specs, f_specs), mesh=mesh,
             in_specs=(t_specs, f_specs, P(), batch_spec),
             out_specs=(P(), t_specs),

@@ -15,7 +15,11 @@ TPU-first differences:
     shards batches and state instead of DDP/FSDP wrappers;
   - errors are NOT swallowed per batch/epoch (reference defect §2.3 #9);
   - checkpoints carry optimizer state + step and can resume (the reference
-    cannot).
+    cannot);
+  - fault tolerance (training/resilience.py): SIGTERM/SIGINT checkpoint-
+    and-stop at the step boundary, a data cursor in checkpoint metadata so
+    resume fast-forwards to the exact mid-epoch batch, --keep_ckpts
+    retention GC, and an optional loss watchdog that halts on divergence.
 """
 
 from __future__ import annotations
@@ -39,6 +43,12 @@ from building_llm_from_scratch_tpu.training.checkpoint import (
     export_params,
     load_checkpoint,
     save_checkpoint,
+)
+from building_llm_from_scratch_tpu.training.resilience import (
+    GracefulStopper,
+    LossWatchdog,
+    PreemptionStop,
+    prune_checkpoints,
 )
 from building_llm_from_scratch_tpu.training.optim import (
     build_optimizer,
@@ -79,7 +89,10 @@ class Trainer:
                  warmup_sample: bool = False,
                  profile_dir: Optional[str] = None,
                  profile_steps: int = 10,
-                 show_progress: bool = True):
+                 show_progress: bool = True,
+                 keep_ckpts: int = 0,
+                 watchdog: Optional[LossWatchdog] = None,
+                 stopper: Optional[GracefulStopper] = None):
         self.cfg = cfg
         self.tokenizer = tokenizer
         self.loader = loader
@@ -104,6 +117,16 @@ class Trainer:
         self.profile_steps = profile_steps
         self.show_progress = show_progress
         self._profiling = False
+        self.keep_ckpts = keep_ckpts
+        self.watchdog = watchdog
+        self.stopper = stopper
+        # (epoch, file_index, batch_index) of the NEXT batch to train —
+        # written into checkpoint metadata so resume fast-forwards the
+        # deterministic shuffled loader to the exact mid-epoch position
+        self._cursor: Optional[Dict[str, int]] = None
+        self._resume_cursor: Optional[Dict[str, int]] = None
+        self.preempted = False
+        self._pending_losses: List[Any] = []
 
         if (lora_params is None) != (lora_rank is None):
             raise ValueError(
@@ -138,11 +161,27 @@ class Trainer:
         it (e.g. resuming with extra epochs)."""
         prev_steps = 0
         prev_horizon = 0
+        mid_run = False
         if self.resume_from is not None:
             meta = checkpoint_metadata(self.resume_from)
+            ckpt_model = meta.get("model")
+            if ckpt_model and ckpt_model != self.cfg.name:
+                raise ValueError(
+                    f"Checkpoint {self.resume_from} was written by model "
+                    f"'{ckpt_model}' but this run builds '{self.cfg.name}' "
+                    "— a stale checkpoint in a reused --output_dir? Pass "
+                    "--resume off for a fresh start or point --resume_from "
+                    "at a matching checkpoint.")
             prev_steps = int(meta.get("global_step", 0))
             prev_horizon = int(meta.get("schedule_horizon", 0))
-        horizon = max(prev_horizon, total_steps + prev_steps)
+            # a data cursor marks a MID-RUN checkpoint: the caller re-runs
+            # the ORIGINAL plan (total_steps already counts the epochs the
+            # cursor will fast-forward past), so the horizon must not grow
+            # by the steps already taken. Cursor-less checkpoints (final)
+            # keep the historical "train total_steps more" semantics.
+            mid_run = meta.get("cursor") is not None
+        horizon = max(prev_horizon,
+                      total_steps if mid_run else total_steps + prev_steps)
         self._schedule_horizon = horizon
         self.lr_schedule = warmup_cosine_schedule(
             self.opt_hparams["peak_lr"], self.opt_hparams["initial_lr"],
@@ -185,8 +224,17 @@ class Trainer:
             meta = checkpoint_metadata(self.resume_from)
             self.global_step = int(meta.get("global_step", 0))
             self.tokens_seen = int(meta.get("tokens_seen", 0))
-            logger.info("Resumed from %s at step %d (%d tokens seen)",
-                        self.resume_from, self.global_step, self.tokens_seen)
+            # mid-run checkpoints carry a data cursor; final ones do not
+            # (resuming a COMPLETED run means "train n_epochs more"). The
+            # LIVE cursor starts as the restored one so an interruption
+            # before the first post-resume step re-checkpoints the same
+            # position instead of silently dropping it
+            self._resume_cursor = meta.get("cursor")
+            self._cursor = self._resume_cursor
+            logger.info("Resumed from %s at step %d (%d tokens seen)%s",
+                        self.resume_from, self.global_step, self.tokens_seen,
+                        f", data cursor {self._resume_cursor}"
+                        if self._resume_cursor else "")
         self.state = state
         kw = dict(lora_alpha=self.lora_alpha, lora_rank=self.lora_rank,
                   policy=self.policy,
@@ -294,18 +342,60 @@ class Trainer:
     # Checkpointing (reference train.py:231-257)
     # ------------------------------------------------------------------
 
-    def save_checkpoint(self, tag: str) -> str:
+    def save_checkpoint(self, tag: str,
+                        cursor: Optional[Dict[str, int]] = None) -> str:
         path = os.path.join(self.output_dir, f"model_pg_{tag}")
-        save_checkpoint(path, self.state, extra_metadata={
+        metadata = {
             "global_step": self.global_step,
             "tokens_seen": self.tokens_seen,
             "model": self.cfg.name,
             # resume rebuilds the cosine schedule over THIS horizon so the
             # decay matches an uninterrupted run (round-2 ADVICE low #5)
             "schedule_horizon": getattr(self, "_schedule_horizon", 0),
-        })
+        }
+        if cursor is not None:
+            metadata["cursor"] = cursor
+        save_checkpoint(path, self.state, extra_metadata=metadata)
         logger.info("Saved checkpoint %s", path)
         return path
+
+    def _prune_old_checkpoints(self) -> None:
+        """--keep_ckpts retention GC after a successful periodic save:
+        coordinator-only deletion of the oldest step-tagged checkpoints
+        (never ``interrupted``/``final``, never the one just written)."""
+        if self.keep_ckpts > 0 and jax.process_index() == 0:
+            prune_checkpoints(self.output_dir, keep=self.keep_ckpts)
+
+    def _resume_skip(self, epoch: int, file_index: int, path: str = ""):
+        """(skip_batches, skip_file_entirely) for the resume fast-forward.
+
+        The restored cursor names the next (epoch, file, batch) to train;
+        earlier files replay nothing, the cursor's own file skips its
+        already-trained batch prefix (the loader's shuffle is deterministic
+        in (seed, epoch), so position k is reproduced exactly), and
+        everything after runs normally. The cursor also fingerprints its
+        file by basename: a data_dir whose contents shifted between
+        launches would otherwise fast-forward into the WRONG file while
+        claiming an exact resume."""
+        cur = self._resume_cursor
+        if not cur:
+            return 0, False
+        ce = int(cur.get("epoch", 0))
+        cf = int(cur.get("file_index", 0))
+        if (epoch, file_index) < (ce, cf):
+            return 0, True
+        if (epoch, file_index) == (ce, cf):
+            want = cur.get("file")
+            have = os.path.basename(path) if path else ""
+            if want and have and want != have:
+                raise ValueError(
+                    f"Resume cursor points at file '{want}' (position "
+                    f"{cf}) but the discovered file list now has '{have}' "
+                    "there — data_dir contents changed since the "
+                    "checkpoint. Restore the original file list or restart "
+                    "with --resume off.")
+            return int(cur.get("batch_index", 0)), False
+        return 0, False
 
     # ------------------------------------------------------------------
     # Core loops (reference train.py:128-211)
@@ -314,8 +404,13 @@ class Trainer:
     def _run_epoch(self, train_batches_fn: Callable[[int], Any],
                    val_batches_fn: Callable[[int], Any], epoch: int,
                    start_context: str, n_batches: Optional[int] = None,
-                   desc: str = ""):
-        """One pass over one file's batches with cadence work."""
+                   desc: str = "", file_index: int = 0,
+                   skip_batches: int = 0, file_name: str = ""):
+        """One pass over one file's batches with cadence work.
+
+        ``skip_batches`` fast-forwards a resumed run past the batches the
+        checkpointed cursor already trained (the iterator is consumed
+        cheaply — batches materialize lazily)."""
         if self.warmup_sample and self.global_step == 0:
             # warm-up sample before the first step (reference main.py:143-145)
             self.generate_and_print_sample(start_context)
@@ -328,6 +423,12 @@ class Trainer:
             self._profile_stop_at = self.global_step + self.profile_steps
         t_tokens, t_start = 0, time.perf_counter()
         batches = train_batches_fn(epoch)
+        if skip_batches:
+            import itertools
+
+            batches = itertools.islice(batches, skip_batches, None)
+            if n_batches is not None:
+                n_batches = max(0, n_batches - skip_batches)
         if self.show_progress and jax.process_index() == 0:
             # per-file batch progress (reference train.py:159,188 wraps the
             # loader in tqdm); leave=False keeps the log uncluttered
@@ -335,10 +436,15 @@ class Trainer:
 
             batches = tqdm(batches, total=n_batches, desc=desc,
                            unit="batch", leave=False)
+        batch_in_file = skip_batches
         for arrays in batches:
             batch = self._device_batch(arrays)
             self.state, metrics = self.train_step(self.state, batch)
             self.global_step += 1
+            batch_in_file += 1
+            self._cursor = {"epoch": epoch, "file_index": file_index,
+                            "file": file_name,
+                            "batch_index": batch_in_file}
             n_tok = int(np.prod(arrays[0].shape))
             self.tokens_seen += n_tok
             t_tokens += n_tok
@@ -353,6 +459,15 @@ class Trainer:
             except (AttributeError, RuntimeError):
                 pass
             self._pending_lrs.append(lr)
+            if self.watchdog is not None and "loss" in metrics:
+                # same deferred-fetch discipline as lr: the watchdog reads
+                # these at flush cadence, never blocking the step loop
+                loss = metrics["loss"]
+                try:
+                    loss.copy_to_host_async()
+                except (AttributeError, RuntimeError):
+                    pass
+                self._pending_losses.append(loss)
 
             if self._profiling and self.global_step >= self._profile_stop_at:
                 jax.profiler.stop_trace()
@@ -384,9 +499,24 @@ class Trainer:
                 self.generate_and_print_sample(start_context)
 
             if self.global_step % self.save_ckpt_freq == 0:
-                self.save_checkpoint(str(self.global_step))
+                self.save_checkpoint(str(self.global_step),
+                                     cursor=self._cursor)
+                self._prune_old_checkpoints()
 
-    def _flush_metrics(self):
+            if self.stopper is not None and self.stopper.should_stop():
+                # preemption-safe stop at the step boundary: the signal was
+                # observed locally, but the decision is GLOBAL (should_stop
+                # all-reduces the flag), so every host reaches the
+                # checkpoint collectives below together instead of one host
+                # exiting while its peers hang in a psum
+                logger.warning(
+                    "Graceful stop requested: writing checkpoint at step "
+                    "%d and exiting.", self.global_step)
+                self.save_checkpoint("interrupted", cursor=self._cursor)
+                self.preempted = True
+                raise PreemptionStop
+
+    def _flush_metrics(self, check_watchdog: bool = True):
         """Fetch pending per-step device metrics to host floats. Per-scalar
         blocking float() at step time costs a round trip each (~100ms over a
         remote-tunnel backend; round-2 VERDICT weak #3), so values are
@@ -407,6 +537,15 @@ class Trainer:
             self.track_lrs.extend(
                 float(np.asarray(lr)) for lr in self._pending_lrs)
             self._pending_lrs.clear()
+        if self._pending_losses:
+            fetched = [float(np.asarray(x)) for x in self._pending_losses]
+            self._pending_losses.clear()
+            if self.watchdog is not None and check_watchdog:
+                # base step of the oldest pending loss, so the diagnostic
+                # names the step the divergence actually happened at
+                base = self.global_step - len(fetched)
+                for i, loss in enumerate(fetched):
+                    self.watchdog.observe(base + i + 1, loss)
 
     def _stop_profiler(self):
         if self._profiling:
@@ -423,7 +562,11 @@ class Trainer:
         logger.info("Total training steps: %d", total_steps)
         try:
             for epoch in range(n_epochs):
-                for path in files:
+                for file_index, path in enumerate(files):
+                    skip, skip_file = self._resume_skip(epoch, file_index,
+                                                        path)
+                    if skip_file:
+                        continue
                     text = read_text_file(path) + f" {self.cfg.eos_text} "
                     train_ds, val_ds = self.loader.create_datasets(text)
                     if self.loader.num_batches(train_ds) == 0:
@@ -438,13 +581,27 @@ class Trainer:
                         epoch, start_context,
                         n_batches=self.loader.num_batches(train_ds),
                         desc=f"epoch {epoch + 1}/{n_epochs} "
-                             f"{os.path.basename(path)}")
+                             f"{os.path.basename(path)}",
+                        file_index=file_index, skip_batches=skip,
+                        file_name=os.path.basename(path))
+        except PreemptionStop:
+            logger.warning(
+                "Training stopped gracefully at step %d; relaunch with "
+                "--resume auto to continue.", self.global_step)
         except KeyboardInterrupt:
-            self.save_checkpoint("interrupted")
+            # best-effort abort save (direct Ctrl-C with no stopper, or the
+            # impatient second SIGINT): the interrupt is asynchronous, so in
+            # the tiny window between the step-count and cursor updates the
+            # saved cursor can trail the state by one batch — resume then
+            # replays that batch. The GRACEFUL stop path (stopper) saves at
+            # an exact step boundary and has no such window.
+            self.save_checkpoint("interrupted", cursor=self._cursor)
             raise
         finally:
             self._stop_profiler()
-            self._flush_metrics()
+            # no watchdog here: raising out of finally would mask an
+            # in-flight exception from the try body
+            self._flush_metrics(check_watchdog=False)
         return self
 
     def finetune_model(self, files: Sequence[str], n_epochs: int):
@@ -455,7 +612,11 @@ class Trainer:
         logger.info("Total finetuning steps: %d", total_steps)
         try:
             for epoch in range(n_epochs):
-                for path in files:
+                for file_index, path in enumerate(files):
+                    skip, skip_file = self._resume_skip(epoch, file_index,
+                                                        path)
+                    if skip_file:
+                        continue
                     records = read_json_file(path)
                     train_ds, val_ds = self.loader.create_datasets(records)
                     if self.loader.num_batches(train_ds) == 0:
@@ -478,13 +639,25 @@ class Trainer:
                         epoch, start_context,
                         n_batches=self.loader.num_batches(train_ds),
                         desc=f"epoch {epoch + 1}/{n_epochs} "
-                             f"{os.path.basename(path)}")
+                             f"{os.path.basename(path)}",
+                        file_index=file_index, skip_batches=skip,
+                        file_name=os.path.basename(path))
+        except PreemptionStop:
+            logger.warning(
+                "Finetuning stopped gracefully at step %d; relaunch with "
+                "--resume auto to continue.", self.global_step)
         except KeyboardInterrupt:
-            self.save_checkpoint("interrupted")
+            # best-effort abort save (direct Ctrl-C with no stopper, or the
+            # impatient second SIGINT): the interrupt is asynchronous, so in
+            # the tiny window between the step-count and cursor updates the
+            # saved cursor can trail the state by one batch — resume then
+            # replays that batch. The GRACEFUL stop path (stopper) saves at
+            # an exact step boundary and has no such window.
+            self.save_checkpoint("interrupted", cursor=self._cursor)
             raise
         finally:
             self._stop_profiler()
-            self._flush_metrics()
+            self._flush_metrics(check_watchdog=False)
         return self
 
     def export_final(self, filename: str = "model_pg_final.npz") -> str:

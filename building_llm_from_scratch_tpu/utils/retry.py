@@ -1,0 +1,106 @@
+"""Bounded retry with exponential backoff + jitter for remote fetches.
+
+HF hub downloads (weights/fetch.py, data/tokenizers.py) run on shared
+infrastructure where transient 5xx/connection-reset failures are routine —
+on a multi-host TPU pod one flaky fetch otherwise kills the whole job at
+startup. The policy here: up to ``attempts`` tries, exponential backoff
+with full jitter (decorrelates the retry stampede across pod hosts), and a
+hard distinction between RETRYABLE errors (connection/timeout/5xx/429) and
+DEFINITIVE ones (404 not-found, gated/auth failures) which re-raise
+immediately — retrying a typo'd repo name three times just hides the real
+error for a minute.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable, Optional, TypeVar
+
+from building_llm_from_scratch_tpu.utils.logging import setup_logger
+
+logger = setup_logger(__name__)
+
+T = TypeVar("T")
+
+# Exception class names that mean "the asset does not exist / you may not
+# have it" — matched by name across the MRO so huggingface_hub (and
+# requests/urllib3 underneath it) never needs to be importable here.
+_DEFINITIVE_NAMES = {
+    "RepositoryNotFoundError",
+    "EntryNotFoundError",
+    "RevisionNotFoundError",
+    "GatedRepoError",
+    "HFValidationError",
+}
+
+_RETRYABLE_NAMES = {
+    "ConnectionError",
+    "ConnectTimeout",
+    "ReadTimeout",
+    "Timeout",
+    "ChunkedEncodingError",
+    "ProtocolError",
+    "IncompleteRead",
+    "RemoteDisconnected",
+    "URLError",
+    "SSLError",
+}
+
+_RETRYABLE_STATUS = {408, 425, 429}
+
+
+def is_retryable_fetch_error(exc: BaseException) -> bool:
+    """Classify a fetch failure: True for transient network conditions,
+    False for definitive answers (404/gated/invalid-repo) where a retry
+    only delays the real error message."""
+    names = {c.__name__ for c in type(exc).__mro__}
+    if names & _DEFINITIVE_NAMES:
+        return False
+    status = getattr(getattr(exc, "response", None), "status_code", None)
+    if status is not None:
+        return status in _RETRYABLE_STATUS or 500 <= int(status) <= 599
+    if names & _RETRYABLE_NAMES:
+        return True
+    if isinstance(exc, (ConnectionError, TimeoutError)):
+        return True
+    # socket-level failures surface as OSError; local filesystem problems
+    # (missing file, permissions) are NOT transient
+    if isinstance(exc, OSError) and not isinstance(
+            exc, (FileNotFoundError, PermissionError, IsADirectoryError,
+                  NotADirectoryError)):
+        return True
+    return False
+
+
+def with_retries(fn: Callable[[], T], *, attempts: int = 3,
+                 base_delay: float = 1.0, max_delay: float = 30.0,
+                 is_retryable: Callable[[BaseException], bool]
+                 = is_retryable_fetch_error,
+                 describe: str = "remote fetch",
+                 sleep: Optional[Callable[[float], None]] = None,
+                 rng: Callable[[], float] = random.random) -> T:
+    """Call ``fn`` with up to ``attempts`` tries.
+
+    Non-retryable errors and the final attempt's error re-raise unchanged
+    (the caller's error handling sees the original exception). Between
+    retryable failures, sleeps ``base_delay * 2^attempt`` capped at
+    ``max_delay``, plus up to 100% jitter. ``sleep``/``rng`` are injectable
+    for tests.
+    """
+    if attempts < 1:
+        raise ValueError(f"attempts must be >= 1, got {attempts}")
+    for attempt in range(attempts):
+        try:
+            return fn()
+        except Exception as e:
+            if attempt == attempts - 1 or not is_retryable(e):
+                raise
+            delay = min(max_delay, base_delay * (2 ** attempt))
+            delay += rng() * delay
+            logger.warning(
+                "%s failed (%s: %s); retrying in %.1fs (attempt %d/%d)",
+                describe, type(e).__name__, e, delay, attempt + 1, attempts)
+            # resolved at call time so tests can stub the module's clock
+            (sleep if sleep is not None else time.sleep)(delay)
+    raise AssertionError("unreachable")

@@ -258,7 +258,12 @@ HF_LLAMA_FILES: Dict[str, Tuple[str, List[str], str]] = {
 
 def _resolve_files(repo_id: str, filenames: List[str],
                    weights_dir: Optional[str], cache_dir: str) -> List[str]:
-    """Local-first file resolution with cache-if-exists semantics."""
+    """Local-first file resolution with cache-if-exists semantics.
+
+    Hub downloads get a bounded retry (3 attempts, exponential backoff +
+    jitter — utils/retry.py): transient network failures on shared hub
+    infrastructure must not kill a pod-wide job at startup, while 404/gated
+    errors re-raise immediately."""
     if weights_dir is not None:
         paths = [os.path.join(weights_dir, f) for f in filenames]
         missing = [p for p in paths if not os.path.exists(p)]
@@ -268,7 +273,12 @@ def _resolve_files(repo_id: str, filenames: List[str],
         return paths
     from huggingface_hub import hf_hub_download
 
-    return [hf_hub_download(repo_id=repo_id, filename=f, cache_dir=cache_dir)
+    from building_llm_from_scratch_tpu.utils.retry import with_retries
+
+    return [with_retries(
+                lambda f=f: hf_hub_download(repo_id=repo_id, filename=f,
+                                            cache_dir=cache_dir),
+                describe=f"download {repo_id}/{f}")
             for f in filenames]
 
 

@@ -1,0 +1,40 @@
+"""Worker for the SIGTERM fault-injection test (spawned by
+tests/test_fault_injection.py — not collected by pytest).
+
+Runs the real CLI ``main()`` on the forced-CPU platform so the parent test
+can deliver a genuine SIGTERM mid-epoch: the GracefulStopper installed by
+main() must checkpoint at the next step boundary and exit 0. The final
+line reports whether the run observed the preemption and at which step.
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main():
+    data_dir, out_dir = sys.argv[1], sys.argv[2]
+    from building_llm_from_scratch_tpu.args import get_args
+    from building_llm_from_scratch_tpu.main import main as run_main
+
+    args = get_args([
+        "--data_dir", data_dir, "--output_dir", out_dir,
+        "--debug", "--byte_tokenizer", "--n_epochs", "1",
+        "--batch_size", "4", "--eval_freq", "10",
+        "--print_sample_iter", "100000", "--save_ckpt_freq", "5",
+        "--warmup_steps", "2", "--keep_ckpts", "2",
+    ])
+    trainer = run_main(args)
+    print(f"WORKER_EXIT preempted={trainer.preempted} "
+          f"step={trainer.global_step}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -58,6 +58,23 @@ def distributed_spawn_lock():
             fcntl.flock(f, fcntl.LOCK_UN)
 
 
+@pytest.fixture(autouse=True)
+def _scrub_stale_ckpt_staging():
+    """Remove checkpoint staging dirs (model_pg_*.tmp/.old) a crashed or
+    interrupted test left in the working tree, so one test's aborted save
+    can never feed a later test's auto-resume discovery."""
+    yield
+    import glob
+    import shutil
+
+    for root in (os.getcwd(), os.path.join(os.getcwd(),
+                                           "model_checkpoints")):
+        for suffix in (".tmp", ".old"):
+            for d in glob.glob(os.path.join(root, f"model_pg_*{suffix}")):
+                if os.path.isdir(d):
+                    shutil.rmtree(d, ignore_errors=True)
+
+
 @pytest.fixture(scope="session")
 def rng_key():
     return jax.random.PRNGKey(0)

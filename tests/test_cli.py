@@ -101,6 +101,7 @@ def _run_shardmap_worker(mode, data_dir, tmp_path):
     raise AssertionError(f"{mode} CLI worker failed 3 times; last: {last}")
 
 
+@pytest.mark.slow
 def test_cli_multichip_sequence_parallel(data_dir, tmp_path):
     """--sp 2 trains with ring attention over the seq mesh axis."""
     _run_shardmap_worker("sp", data_dir, tmp_path)
@@ -114,11 +115,13 @@ def test_checks_sp_accepts_gpt2_dropout(data_dir):
     assert args.sp == 2 and args.model == "GPT2"
 
 
+@pytest.mark.slow
 def test_cli_multichip_pipeline(data_dir, tmp_path):
     """--shard_mode pp trains with the GPipe schedule (2 stages)."""
     _run_shardmap_worker("pp", data_dir, tmp_path)
 
 
+@pytest.mark.slow
 def test_cli_multichip_pipeline_tensor_parallel(data_dir, tmp_path):
     """--shard_mode pp --tp 2: pipeline stages x Megatron tp from the CLI
     (round-5 VERDICT #6)."""
@@ -150,6 +153,7 @@ def test_cli_resume(data_dir, tmp_path):
     assert resumed.tokens_seen == 2 * first.tokens_seen
 
 
+@pytest.mark.slow
 def test_cli_profile(data_dir, tmp_path):
     out = str(tmp_path / "out_p")
     main(_args(data_dir, out, "--profile", "--profile_steps", "2"))

@@ -55,12 +55,14 @@ def _run_workers(tmp_path, nproc: int, mode: str, timeout: int = 240):
         assert f"WORKER_{pid}_OK" in out, out
 
 
+@pytest.mark.slow
 def test_two_process_fsdp_train_and_checkpoint(tmp_path):
     """2 hosts x 4 devices, fsdp: train, sharded save, streamed restore,
     resume step."""
     _run_workers(tmp_path, nproc=2, mode="fsdp")
 
 
+@pytest.mark.slow
 def test_two_process_pipeline_parallel(tmp_path):
     """2 hosts x 4 devices, pp: stage axis over hosts, per-process
     microbatch feeds, 3 finite pipelined train steps (round-5 VERDICT
@@ -68,6 +70,7 @@ def test_two_process_pipeline_parallel(tmp_path):
     _run_workers(tmp_path, nproc=2, mode="pp")
 
 
+@pytest.mark.slow
 def test_four_process_zero1_resume(tmp_path):
     """4 hosts x 4 devices (16-device mesh), zero1 optimizer-state
     sharding: train, sharded save, restore, resume (round-3 VERDICT

@@ -1,0 +1,442 @@
+"""Fault-tolerance unit/integration tests (training/resilience.py):
+checkpoint integrity + fallback, retention GC, graceful stop, the loss
+watchdog, clear manifest errors, orphaned-staging cleanup, and the
+data-cursor resume path."""
+
+import json
+import os
+import signal
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from building_llm_from_scratch_tpu.configs import get_config
+from building_llm_from_scratch_tpu.data import ByteTokenizer, PretrainLoader
+from building_llm_from_scratch_tpu.models import init_params
+from building_llm_from_scratch_tpu.training import Trainer
+from building_llm_from_scratch_tpu.training.checkpoint import (
+    checkpoint_metadata,
+    load_checkpoint,
+    save_checkpoint,
+)
+from building_llm_from_scratch_tpu.training.resilience import (
+    GracefulStopper,
+    LossWatchdog,
+    TrainingDivergedError,
+    find_latest_valid_checkpoint,
+    list_checkpoints,
+    prune_checkpoints,
+    resolve_resume,
+    validate_checkpoint,
+)
+
+# ---------------------------------------------------------------------------
+# Helpers
+# ---------------------------------------------------------------------------
+
+STATE = {"w": jnp.arange(64, dtype=jnp.float32).reshape(8, 8),
+         "b": jnp.ones((8,), jnp.float32)}
+
+
+def _save(out_dir, tag, step):
+    return save_checkpoint(os.path.join(out_dir, f"model_pg_{tag}"), STATE,
+                           extra_metadata={"global_step": step})
+
+
+def _first_shard(ckpt_dir):
+    with open(os.path.join(ckpt_dir, "manifest.json")) as f:
+        manifest = json.load(f)
+    return os.path.join(ckpt_dir, manifest["leaves"][0]["shards"][0]["file"])
+
+
+def _flip_byte(path, offset=-1):
+    with open(path, "r+b") as f:
+        f.seek(offset, os.SEEK_END)
+        byte = f.read(1)
+        f.seek(-1, os.SEEK_CUR)
+        f.write(bytes([byte[0] ^ 0xFF]))
+
+
+def tiny_cfg():
+    # smaller than --debug for fast compiles: these tests train real steps
+    return get_config("GPT2", "124M", debug=True).replace(
+        emb_dim=32, hidden_dim=64, n_layers=2, n_heads=2, vocab_size=257,
+        context_length=16)
+
+
+def make_trainer(tmp_path, params, **kw):
+    tok = ByteTokenizer()
+    loader = PretrainLoader(tok, batch_size=2, max_length=16)
+    defaults = dict(output_dir=str(tmp_path / "out"), eval_freq=4,
+                    print_sample_iter=100000, save_ckpt_freq=100000,
+                    warmup_steps=2, show_progress=False)
+    defaults.update(kw)
+    return Trainer(tiny_cfg(), params, tok, loader, **defaults)
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint integrity: checksums, truncation, back-compat, fallback
+# ---------------------------------------------------------------------------
+
+def test_manifest_records_bytes_and_sha256(tmp_path):
+    ck = _save(str(tmp_path), "10", 10)
+    with open(os.path.join(ck, "manifest.json")) as f:
+        manifest = json.load(f)
+    for leaf in manifest["leaves"]:
+        for sh in leaf["shards"]:
+            assert sh["bytes"] == os.path.getsize(os.path.join(ck, sh["file"]))
+            assert len(sh["sha256"]) == 64
+    assert validate_checkpoint(ck) is None
+
+
+def test_validate_rejects_bitflipped_shard(tmp_path):
+    ck = _save(str(tmp_path), "10", 10)
+    _flip_byte(_first_shard(ck))
+    reason = validate_checkpoint(ck)
+    assert reason is not None and "sha256" in reason
+
+
+def test_validate_rejects_truncated_shard(tmp_path):
+    ck = _save(str(tmp_path), "10", 10)
+    shard = _first_shard(ck)
+    os.truncate(shard, os.path.getsize(shard) - 8)
+    reason = validate_checkpoint(ck)
+    assert reason is not None and "truncated" in reason
+
+
+def test_validate_rejects_missing_shard_and_manifest(tmp_path):
+    ck = _save(str(tmp_path), "10", 10)
+    os.remove(_first_shard(ck))
+    assert "missing" in validate_checkpoint(ck)
+    assert "manifest" in validate_checkpoint(str(tmp_path / "nope"))
+
+
+def test_validate_accepts_old_manifest_without_checksums(tmp_path):
+    """Checkpoints written before the integrity fields existed must keep
+    validating (existence-only)."""
+    ck = _save(str(tmp_path), "10", 10)
+    mpath = os.path.join(ck, "manifest.json")
+    with open(mpath) as f:
+        manifest = json.load(f)
+    for leaf in manifest["leaves"]:
+        for sh in leaf["shards"]:
+            del sh["bytes"], sh["sha256"]
+    with open(mpath, "w") as f:
+        json.dump(manifest, f)
+    assert validate_checkpoint(ck) is None
+    _flip_byte(_first_shard(ck))          # undetectable without checksums
+    assert validate_checkpoint(ck) is None
+
+
+def test_auto_resume_falls_back_past_corrupt_latest(tmp_path):
+    """The acceptance case: a corrupt latest checkpoint must not crash the
+    resume — discovery falls back to the previous VALID one, loudly."""
+    out = str(tmp_path)
+    _save(out, "10", 10)
+    ck20 = _save(out, "20", 20)
+    assert find_latest_valid_checkpoint(out) == ck20
+    _flip_byte(_first_shard(ck20))
+    assert find_latest_valid_checkpoint(out).endswith("model_pg_10")
+    # resolve_resume("auto") routes through the same fallback
+    assert resolve_resume("auto", None, out).endswith("model_pg_10")
+
+
+def test_list_checkpoints_orders_by_step_and_skips_junk(tmp_path):
+    out = str(tmp_path)
+    _save(out, "5", 5)
+    _save(out, "interrupted", 12)
+    _save(out, "final", 8)
+    (tmp_path / "model_pg_final.npz").write_bytes(b"not a dir")
+    os.makedirs(tmp_path / "model_pg_junk")          # no manifest
+    found = list_checkpoints(out)
+    assert [s for s, _ in found] == [5, 8, 12]
+    assert found[-1][1].endswith("model_pg_interrupted")
+
+
+def test_resolve_resume_modes(tmp_path):
+    out = str(tmp_path)
+    assert resolve_resume("off", None, out) is None
+    assert resolve_resume("auto", None, out) is None          # nothing there
+    ck = _save(out, "10", 10)
+    assert resolve_resume("auto", None, out) == ck
+    assert resolve_resume("off", None, out) is None
+    assert resolve_resume("auto", "/explicit/wins", out) == "/explicit/wins"
+    assert resolve_resume(ck, None, str(tmp_path / "empty")) == ck
+
+
+# ---------------------------------------------------------------------------
+# Retention GC
+# ---------------------------------------------------------------------------
+
+def test_prune_keeps_newest_and_protected_tags(tmp_path):
+    out = str(tmp_path)
+    for step in (1, 2, 3, 4, 5):
+        _save(out, str(step), step)
+    _save(out, "interrupted", 3)
+    _save(out, "final", 5)
+    removed = prune_checkpoints(out, keep=2)
+    assert sorted(os.path.basename(p) for p in removed) == [
+        "model_pg_1", "model_pg_2", "model_pg_3"]
+    left = sorted(n for n in os.listdir(out) if n.startswith("model_pg_"))
+    assert left == ["model_pg_4", "model_pg_5", "model_pg_final",
+                    "model_pg_interrupted"]
+    assert prune_checkpoints(out, keep=2) == []               # idempotent
+    with pytest.raises(ValueError, match="keep"):
+        prune_checkpoints(out, keep=0)
+
+
+def test_trainer_keep_ckpts_bounds_disk(tmp_path):
+    """Acceptance: --keep_ckpts 2 leaves at most 2 step-tagged dirs after a
+    run with >= 5 saves (interrupted/final tags untouched)."""
+    cfg = tiny_cfg()
+    datafile = tmp_path / "c.txt"
+    datafile.write_text("the quick brown fox jumps over the lazy dog. " * 8)
+    trainer = make_trainer(tmp_path, init_params(cfg, jax.random.PRNGKey(0)),
+                           save_ckpt_freq=1, keep_ckpts=2)
+    trainer.train_model([str(datafile)], n_epochs=1, start_context="the ")
+    assert trainer.global_step >= 5                  # >= 5 saves happened
+    out = str(tmp_path / "out")
+    tagged = sorted(int(n[len("model_pg_"):]) for n in os.listdir(out)
+                    if n[len("model_pg_"):].isdigit())
+    assert len(tagged) <= 2
+    assert tagged[-1] == trainer.global_step         # newest never pruned
+    # step-tagged checkpoints carry the data cursor for mid-epoch resume
+    meta = checkpoint_metadata(os.path.join(out, f"model_pg_{tagged[-1]}"))
+    assert meta["cursor"] == {"epoch": 0, "file_index": 0, "file": "c.txt",
+                              "batch_index": trainer.global_step}
+
+
+# ---------------------------------------------------------------------------
+# Clear manifest errors + orphaned staging cleanup (satellite)
+# ---------------------------------------------------------------------------
+
+def test_load_missing_manifest_raises_single_clear_error(tmp_path):
+    empty = tmp_path / "model_pg_7"
+    empty.mkdir()
+    with pytest.raises(ValueError, match="manifest.json is missing"):
+        load_checkpoint(str(empty), dict(STATE))
+    with pytest.raises(ValueError, match=str(empty)):
+        checkpoint_metadata(str(empty))
+
+
+def test_load_malformed_manifest_raises_single_clear_error(tmp_path):
+    ck = tmp_path / "model_pg_7"
+    ck.mkdir()
+    (ck / "manifest.json").write_text("{not json")
+    with pytest.raises(ValueError, match="malformed"):
+        load_checkpoint(str(ck), dict(STATE))
+    (ck / "manifest.json").write_text('{"no_leaves": 1}')
+    with pytest.raises(ValueError, match="leaves"):
+        checkpoint_metadata(str(ck))
+
+
+def test_validate_never_raises_on_structural_corruption(tmp_path):
+    """validate_checkpoint exists to let --resume auto fall back past
+    corrupt checkpoints, so ANY corruption shape must come back as a
+    reason string, never an exception."""
+    ck = tmp_path / "model_pg_9"
+    ck.mkdir()
+    for payload in ('{"leaves": [42]}',                    # leaf not a dict
+                    '{"leaves": [{"shards": [{}]}]}',      # shard sans file
+                    '{"leaves": "nope"}', "{not json"):
+        (ck / "manifest.json").write_text(payload)
+        reason = validate_checkpoint(str(ck))
+        assert isinstance(reason, str) and reason, payload
+    # and discovery walks past it instead of crashing
+    good = _save(str(tmp_path), "5", 5)
+    assert find_latest_valid_checkpoint(str(tmp_path)) == good
+
+
+def test_load_cleans_orphaned_staging_dirs(tmp_path):
+    ck = _save(str(tmp_path), "10", 10)
+    for suffix in (".tmp", ".old"):
+        os.makedirs(ck + suffix)
+        with open(os.path.join(ck + suffix, "leaf_junk.npy"), "w") as f:
+            f.write("stale")
+    restored = load_checkpoint(ck, jax.tree_util.tree_map(jnp.zeros_like,
+                                                          STATE))
+    np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                  np.asarray(STATE["w"]))
+    assert not os.path.exists(ck + ".tmp")
+    assert not os.path.exists(ck + ".old")
+
+
+def test_interrupted_commit_window_still_resumable(tmp_path):
+    """A save preempted between the two commit renames leaves only .tmp —
+    discovery and load must still see it (via _resolve_ckpt_dir)."""
+    ck = _save(str(tmp_path), "10", 10)
+    os.rename(ck, ck + ".tmp")
+    assert validate_checkpoint(ck) is None
+    assert find_latest_valid_checkpoint(str(tmp_path)) == ck
+    restored = load_checkpoint(ck, jax.tree_util.tree_map(jnp.zeros_like,
+                                                          STATE))
+    np.testing.assert_array_equal(np.asarray(restored["b"]), np.ones((8,)))
+
+
+# ---------------------------------------------------------------------------
+# Graceful stop + loss watchdog
+# ---------------------------------------------------------------------------
+
+def test_graceful_stopper_signal_sets_flag_and_restores_handlers():
+    before_term = signal.getsignal(signal.SIGTERM)
+    stopper = GracefulStopper()
+    with stopper:
+        assert not stopper.should_stop()
+        if signal.SIGTERM not in stopper._previous:
+            pytest.skip("signal handlers unavailable (non-main thread)")
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.monotonic() + 5
+        while not stopper.requested and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert stopper.requested and stopper.should_stop()
+    assert signal.getsignal(signal.SIGTERM) == before_term
+
+
+def test_watchdog_halts_on_nonfinite_and_spike():
+    wd = LossWatchdog(spike_factor=5.0, window=10, min_history=4)
+    for i in range(8):
+        wd.observe(i, 2.0 + 0.01 * i)
+    with pytest.raises(TrainingDivergedError, match="spiked"):
+        wd.observe(9, 50.0)
+    with pytest.raises(TrainingDivergedError, match="non-finite"):
+        wd.observe(10, float("nan"))
+    # warmup noise (short history) never trips the spike check
+    wd2 = LossWatchdog(spike_factor=5.0, min_history=4)
+    wd2.observe(0, 1.0)
+    wd2.observe(1, 100.0)
+
+
+def test_trainer_watchdog_halts_on_diverged_loss(tmp_path):
+    """End-to-end: a poisoned step metric stops training with a diagnostic
+    instead of running to completion."""
+    cfg = tiny_cfg()
+    datafile = tmp_path / "c.txt"
+    datafile.write_text("pack my box with five dozen liquor jugs. " * 12)
+    trainer = make_trainer(
+        tmp_path, init_params(cfg, jax.random.PRNGKey(0)), eval_freq=2,
+        watchdog=LossWatchdog(spike_factor=5.0, min_history=1,
+                              check_finite=True))
+    real_setup = trainer._setup
+
+    def poisoned_setup(total_steps):
+        real_setup(total_steps)
+        real_step = trainer.train_step
+
+        def bad_step(state, batch):
+            state, metrics = real_step(state, batch)
+            if int(state["step"]) >= 4:
+                metrics = dict(metrics, loss=jnp.asarray(float("inf")))
+            return state, metrics
+
+        trainer.train_step = bad_step
+
+    trainer._setup = poisoned_setup
+    with pytest.raises(TrainingDivergedError, match="non-finite"):
+        trainer.train_model([str(datafile)], n_epochs=1, start_context="a")
+
+
+# ---------------------------------------------------------------------------
+# Interrupted checkpoint + data-cursor resume (satellite + tentpole)
+# ---------------------------------------------------------------------------
+
+class InterruptingLoader(PretrainLoader):
+    """Raises KeyboardInterrupt after yielding N training batches — the
+    Ctrl-C-mid-epoch fixture."""
+
+    def __init__(self, *a, interrupt_after=3, **kw):
+        super().__init__(*a, **kw)
+        self.remaining = interrupt_after
+
+    def batches(self, dataset, **kw):
+        inner = super().batches(dataset, **kw)
+
+        def gen():
+            for b in inner:
+                if self.remaining <= 0:
+                    raise KeyboardInterrupt
+                self.remaining -= 1
+                yield b
+        return gen()
+
+
+def test_keyboard_interrupt_checkpoint_roundtrips_and_resumes(tmp_path):
+    """Satellite: KeyboardInterrupt mid-_run_epoch writes a checkpoint that
+    round-trips through load_checkpoint and resumes at the right step."""
+    cfg = tiny_cfg()
+    datafile = tmp_path / "c.txt"
+    datafile.write_text("the quick brown fox jumps over the lazy dog. " * 12)
+    tok = ByteTokenizer()
+    loader = InterruptingLoader(tok, batch_size=2, max_length=16,
+                                interrupt_after=3)
+    trainer = Trainer(cfg, init_params(cfg, jax.random.PRNGKey(0)), tok,
+                      loader, output_dir=str(tmp_path / "out"),
+                      eval_freq=100000, print_sample_iter=100000,
+                      save_ckpt_freq=100000, warmup_steps=2,
+                      show_progress=False)
+    with pytest.raises(KeyboardInterrupt):
+        trainer.train_model([str(datafile)], n_epochs=1, start_context="a")
+    assert trainer.global_step == 3
+    ck = os.path.join(str(tmp_path / "out"), "model_pg_interrupted")
+    meta = checkpoint_metadata(ck)
+    assert meta["global_step"] == 3
+    assert meta["cursor"] == {"epoch": 0, "file_index": 0, "file": "c.txt",
+                              "batch_index": 3}
+
+    resumed = make_trainer(tmp_path, init_params(cfg, jax.random.PRNGKey(9)),
+                           resume_from=ck)
+    resumed._setup(10)
+    assert resumed.global_step == 3
+    assert int(resumed.state["step"]) == 3
+    assert resumed._resume_cursor == meta["cursor"]
+
+
+class StopAfter(GracefulStopper):
+    """Deterministic stand-in for a SIGTERM landing during step N."""
+
+    def __init__(self, after):
+        super().__init__(signals=())
+        self.after = after
+
+    def should_stop(self):
+        self.after -= 1
+        return self.after <= 0
+
+
+def test_graceful_stop_resume_matches_uninterrupted_run(tmp_path):
+    """The tentpole invariant, in-process: stop at a step boundary, resume
+    via the data cursor, and the remaining eval-loss trajectory is
+    bit-for-bit the uninterrupted run's."""
+    cfg = tiny_cfg()
+    datafile = tmp_path / "c.txt"
+    datafile.write_text("a stitch in time saves nine, they say. " * 16)
+    params = jax.device_put(init_params(cfg, jax.random.PRNGKey(0)))
+
+    ref = make_trainer(tmp_path, params, output_dir=str(tmp_path / "ref"),
+                       eval_freq=4)
+    ref.train_model([str(datafile)], n_epochs=1, start_context="a")
+    assert ref.global_step >= 12
+
+    stopped = make_trainer(tmp_path, params,
+                           output_dir=str(tmp_path / "pre"),
+                           eval_freq=4, stopper=StopAfter(7))
+    stopped.train_model([str(datafile)], n_epochs=1, start_context="a")
+    assert stopped.preempted and stopped.global_step == 7
+    ck = os.path.join(str(tmp_path / "pre"), "model_pg_interrupted")
+    assert checkpoint_metadata(ck)["cursor"]["batch_index"] == 7
+
+    resumed = make_trainer(tmp_path, init_params(cfg, jax.random.PRNGKey(5)),
+                           output_dir=str(tmp_path / "pre"),
+                           eval_freq=4, resume_from=ck)
+    resumed.train_model([str(datafile)], n_epochs=1, start_context="a")
+    assert not resumed.preempted
+    assert resumed.global_step == ref.global_step
+    assert resumed.tokens_seen == ref.tokens_seen
+    n = len(resumed.train_losses)
+    assert n >= 1
+    np.testing.assert_array_equal(np.asarray(resumed.train_losses),
+                                  np.asarray(ref.train_losses[-n:]))
+    np.testing.assert_array_equal(np.asarray(resumed.val_losses),
+                                  np.asarray(ref.val_losses[-n:]))

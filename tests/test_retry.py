@@ -1,0 +1,119 @@
+"""Bounded-retry policy tests (utils/retry.py): transient vs definitive
+error classification, exponential backoff + jitter, attempt bounds, and
+the wiring into the HF fetch paths."""
+
+import pytest
+
+from building_llm_from_scratch_tpu.utils.retry import (
+    is_retryable_fetch_error,
+    with_retries,
+)
+
+
+class EntryNotFoundError(Exception):
+    """Name-matched stand-in for huggingface_hub's 404 error."""
+
+
+class _Resp:
+    def __init__(self, status_code):
+        self.status_code = status_code
+
+
+class HTTPError(Exception):
+    def __init__(self, status):
+        super().__init__(f"http {status}")
+        self.response = _Resp(status)
+
+
+def test_classification():
+    assert is_retryable_fetch_error(ConnectionError("reset"))
+    assert is_retryable_fetch_error(TimeoutError())
+    assert is_retryable_fetch_error(OSError("socket closed"))
+    assert is_retryable_fetch_error(HTTPError(503))
+    assert is_retryable_fetch_error(HTTPError(429))
+    # definitive answers: retrying only delays the real error
+    assert not is_retryable_fetch_error(EntryNotFoundError("404"))
+    assert not is_retryable_fetch_error(HTTPError(404))
+    assert not is_retryable_fetch_error(HTTPError(401))
+    assert not is_retryable_fetch_error(FileNotFoundError("local"))
+    assert not is_retryable_fetch_error(ValueError("bug"))
+
+
+def test_retries_transient_then_succeeds():
+    calls, delays = [], []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionError("reset")
+        return "asset"
+
+    out = with_retries(flaky, sleep=delays.append, rng=lambda: 0.0)
+    assert out == "asset" and len(calls) == 3
+    assert delays == [1.0, 2.0]              # exponential, jitter=0 here
+
+
+def test_jitter_added_to_backoff():
+    delays = []
+
+    def flaky():
+        if len(delays) < 1:
+            raise TimeoutError()
+        return 1
+
+    with_retries(flaky, sleep=delays.append, rng=lambda: 1.0)
+    assert delays == [2.0]                   # base 1.0 + 100% jitter
+
+
+def test_gives_up_after_attempts_and_reraises_original():
+    calls = []
+
+    def always_down():
+        calls.append(1)
+        raise ConnectionError("still down")
+
+    with pytest.raises(ConnectionError, match="still down"):
+        with_retries(always_down, attempts=3, sleep=lambda _: None)
+    assert len(calls) == 3
+
+
+def test_definitive_error_fails_fast():
+    calls = []
+
+    def not_found():
+        calls.append(1)
+        raise EntryNotFoundError("no such repo")
+
+    with pytest.raises(EntryNotFoundError):
+        with_retries(not_found, sleep=lambda _: None)
+    assert len(calls) == 1                   # no retry on a 404-shaped error
+
+
+def test_fetch_paths_route_through_retry(monkeypatch, tmp_path):
+    """weights/fetch._resolve_files and tokenizers.fetch_tokenizer_asset
+    survive two transient hub failures."""
+    import sys
+    import types
+
+    from building_llm_from_scratch_tpu.data import tokenizers
+    from building_llm_from_scratch_tpu.weights import fetch
+
+    calls = []
+
+    def fake_download(repo_id, filename, cache_dir):
+        calls.append(filename)
+        if len(calls) % 3 != 0:
+            raise ConnectionError("flaky hub")
+        return f"/cache/{filename}"
+
+    fake_hub = types.SimpleNamespace(hf_hub_download=fake_download)
+    monkeypatch.setitem(sys.modules, "huggingface_hub", fake_hub)
+    monkeypatch.setattr("building_llm_from_scratch_tpu.utils.retry.time",
+                        types.SimpleNamespace(sleep=lambda _: None))
+
+    got = fetch._resolve_files("org/repo", ["model.safetensors"], None, "c")
+    assert got == ["/cache/model.safetensors"] and len(calls) == 3
+
+    calls.clear()
+    path = tokenizers.fetch_tokenizer_asset("llama3_2", cache_dir="c")
+    assert path.endswith("tokenizer.model") and len(calls) == 3

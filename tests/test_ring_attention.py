@@ -14,6 +14,7 @@ from building_llm_from_scratch_tpu.ops.ring_attention import (
     ring_causal_attention,
 )
 from building_llm_from_scratch_tpu.parallel import build_mesh_plan
+from building_llm_from_scratch_tpu.parallel.collectives import shard_map
 from building_llm_from_scratch_tpu.training import (
     build_optimizer,
     init_train_state,
@@ -314,7 +315,7 @@ def test_sp_inside_forward_matches_global_forward():
 
         body = lambda p, t: forward_hidden(p, cfg, t,
                                            sp_inside=(SEQ_AXIS, 2))
-        got = np.asarray(jax.jit(jax.shard_map(
+        got = np.asarray(jax.jit(shard_map(
             body, mesh=plan.mesh,
             in_specs=(P(), P(DATA_AXIS, SEQ_AXIS)),
             out_specs=P(DATA_AXIS, SEQ_AXIS),

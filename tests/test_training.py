@@ -447,6 +447,7 @@ def test_trainer_train_model_twice(rng_key, tmp_path):
     assert np.isfinite(float(jax.tree_util.tree_leaves(params)[0].sum()))
 
 
+@pytest.mark.slow
 def test_trainer_finetune_end_to_end(rng_key, tmp_path):
     import json
 
