@@ -13,7 +13,10 @@ re-targeted at TPU hardware:
     --byte_tokenizer, --tp, --target_context_length, --resume_from,
     --profile, --seed;
   - fault tolerance (training/resilience.py): --resume auto|off|<dir>,
-    --keep_ckpts, --watchdog/--loss_spike_factor/--watchdog_window.
+    --keep_ckpts, --watchdog/--loss_spike_factor/--watchdog_window;
+  - observability (obs/): --metrics_jsonl structured-telemetry sink,
+    --log_every metrics cadence decoupled from eval, --stall_timeout
+    per-host hung-step flight recorder.
 """
 
 from __future__ import annotations
@@ -184,6 +187,10 @@ def perform_checks(args) -> None:
             "(expected 'auto', 'off', or a checkpoint directory).")
     if args.keep_ckpts < 0:
         raise ValueError("--keep_ckpts must be >= 0 (0 keeps all).")
+    if args.log_every < 0:
+        raise ValueError("--log_every must be >= 0 (0 = eval cadence).")
+    if args.stall_timeout < 0:
+        raise ValueError("--stall_timeout must be >= 0 (0 disables).")
     if args.loss_spike_factor <= 1.0:
         raise ValueError("--loss_spike_factor must be > 1.")
     if args.watchdog_window < 1:
@@ -248,6 +255,28 @@ def get_args(argv=None):
                         help="Evaluation frequency (in steps).")
     parser.add_argument("--save_ckpt_freq", type=int, default=100,
                         help="Checkpoint save frequency (in steps).")
+
+    # Observability (obs/)
+    parser.add_argument("--metrics_jsonl", type=str, default=None,
+                        help="Write structured run telemetry (header + "
+                             "per-cadence metrics + typed events) to this "
+                             "JSONL file (coordinator process only). "
+                             "Render with scripts/summarize_metrics.py.")
+    parser.add_argument("--log_every", type=int, default=0,
+                        help="Steps between throughput/MFU/memory metric "
+                             "lines, decoupled from the (expensive) eval "
+                             "loop. 0 (default) logs at --eval_freq "
+                             "cadence, the historical behavior.")
+    parser.add_argument("--stall_timeout", type=float, default=0.0,
+                        help="Opt-in per-host stall detector: if no train "
+                             "step completes within this many seconds (or "
+                             "10x the rolling median step time — floored "
+                             "at 30s so eval/checkpoint cadence work "
+                             "never false-fires — whichever is sooner), "
+                             "dump all Python thread stacks + device "
+                             "memory stats to the log. Strictly "
+                             "host-local (no collectives — safe when a "
+                             "peer is hung in a psum). 0 disables.")
 
     # Model Configuration
     parser.add_argument("--model", type=str, default="GPT2",

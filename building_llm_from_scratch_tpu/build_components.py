@@ -173,6 +173,13 @@ def build_components(args) -> Components:
         logger.info("Total parameters: %s", f"{n_params:,}")
         logger.info("Estimated training memory (4N Adam rule): %.2f GB",
                     estimate_memory_static(n_params, cfg.dtype))
+    from building_llm_from_scratch_tpu.obs.metrics import emit_event
+
+    emit_event("components_built", model=cfg.name, n_params=n_params,
+               est_train_mem_gb=round(
+                   estimate_memory_static(n_params, cfg.dtype), 3),
+               shard_mode=getattr(args, "shard_mode", None),
+               load_weights=bool(args.load_weights))
 
     lora_params = None
     if args.use_lora:

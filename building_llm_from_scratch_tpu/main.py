@@ -19,7 +19,12 @@ TPU-first differences from the reference:
     so a preempted job relaunches with its original command; SIGTERM/
     SIGINT checkpoint at the next step boundary and exit 0
     (training/resilience.py);
-  - ``--profile`` captures a jax.profiler trace of the first steps.
+  - ``--profile`` captures a jax.profiler trace of the first steps — with
+    named spans and per-step annotations since the obs/ round;
+  - observability (obs/): ``--metrics_jsonl`` structured telemetry
+    (header + metrics + events; scripts/summarize_metrics.py renders it),
+    ``--log_every`` throughput/MFU/memory cadence decoupled from eval,
+    ``--stall_timeout`` per-host hung-step flight recorder.
 
 Usage:  python -m building_llm_from_scratch_tpu --data_dir ... [flags]
 """
@@ -33,6 +38,12 @@ import numpy as np
 from building_llm_from_scratch_tpu.args import get_args
 from building_llm_from_scratch_tpu.build_components import build_components
 from building_llm_from_scratch_tpu.data.instruct import InstructLoader
+from building_llm_from_scratch_tpu.obs import (
+    StallDetector,
+    configure_metrics,
+    emit_event,
+    run_metadata,
+)
 from building_llm_from_scratch_tpu.data.pretrain import PretrainLoader
 from building_llm_from_scratch_tpu.parallel import (
     initialize_distributed,
@@ -67,9 +78,22 @@ def main(args) -> Trainer:
     configure_default_prng()
     set_seed(args.seed)
 
-    # 2. components (reference main.py:63)
+    # 2. observability sink first (--metrics_jsonl; a no-op sink when
+    #    unset, so emit_event callers never care): configured BEFORE the
+    #    component build so fetch/retry events are captured — they buffer
+    #    until the run-metadata header lands below. Then components
+    #    (reference main.py:63).
+    metric_logger = configure_metrics(args.metrics_jsonl)
     comps = build_components(args)
     cfg = comps.cfg
+    metric_logger.write_header(
+        **run_metadata(args=args, cfg=cfg, plan=comps.plan))
+    # constructed here, STARTED just before training inside the
+    # try/finally below: starting now would leak the watcher thread if
+    # loader/trainer setup raises, and start() is what arms the
+    # first-step-hang timer — arming should not charge setup time
+    stall = (StallDetector(args.stall_timeout)
+             if args.stall_timeout > 0 else None)
 
     # 3. training files (reference main.py:68-81)
     txt_files, json_files = discover_training_files(args.data_dir)
@@ -143,16 +167,24 @@ def main(args) -> Trainer:
         keep_ckpts=args.keep_ckpts,
         watchdog=watchdog,
         stopper=stopper,
+        log_every=args.log_every,
+        stall=stall,
     )
 
     # 7. train / finetune (reference main.py:150-157) under the graceful-
     #    stop handler: SIGTERM (preemption) / SIGINT checkpoint at the next
     #    step boundary and fall through here with trainer.preempted set
-    with stopper:
-        if args.finetune:
-            trainer.finetune_model(files, n_epochs=args.n_epochs)
-        else:
-            trainer.train_model(files, n_epochs=args.n_epochs)
+    try:
+        if stall is not None:
+            stall.start()
+        with stopper:
+            if args.finetune:
+                trainer.finetune_model(files, n_epochs=args.n_epochs)
+            else:
+                trainer.train_model(files, n_epochs=args.n_epochs)
+    finally:
+        if stall is not None:
+            stall.stop()
 
     if trainer.preempted:
         # the interrupted checkpoint is on disk; skip the final export so
@@ -179,6 +211,10 @@ def main(args) -> Trainer:
     # 9. final checkpoint + single-file export (reference main.py:171-172)
     trainer.save_checkpoint("final")
     trainer.export_final("model_pg_final.npz")
+    emit_event("run_complete", step=trainer.global_step,
+               tokens_seen=trainer.tokens_seen,
+               final_train_loss=(trainer.train_losses[-1]
+                                 if trainer.train_losses else None))
 
     # 10. barrier before exit (reference main.py:177-179)
     sync_global_devices("run_end")

@@ -1,0 +1,51 @@
+"""Observability subsystem: structured metrics (JSONL), step timeline +
+trace annotations, MFU accounting, and the per-host stall detector.
+
+Entry points:
+  - ``MetricLogger`` / ``configure_metrics`` / ``get_metrics`` /
+    ``emit_event`` — counters, gauges, timings, typed events, JSONL sink
+    (obs/metrics.py);
+  - ``StepTimeline`` / ``annotate`` / ``window_stats`` — per-step
+    wall-clock breakdown + jax.profiler trace annotation (obs/timeline.py);
+  - ``flops_per_token`` / ``compute_mfu`` / ``format_mfu`` — analytic
+    FLOPs and MFU against chip peak (obs/mfu.py);
+  - ``StallDetector`` — opt-in hung-step flight recorder (obs/stall.py).
+"""
+
+from building_llm_from_scratch_tpu.obs.metrics import (
+    MetricLogger,
+    configure_metrics,
+    emit_event,
+    get_metrics,
+    run_metadata,
+)
+from building_llm_from_scratch_tpu.obs.mfu import (
+    compute_mfu,
+    device_peak_flops,
+    flops_per_token,
+    format_mfu,
+)
+from building_llm_from_scratch_tpu.obs.stall import StallDetector
+from building_llm_from_scratch_tpu.obs.timeline import (
+    NON_STEP_SEGMENTS,
+    StepTimeline,
+    annotate,
+    window_stats,
+)
+
+__all__ = [
+    "MetricLogger",
+    "configure_metrics",
+    "emit_event",
+    "get_metrics",
+    "run_metadata",
+    "compute_mfu",
+    "device_peak_flops",
+    "flops_per_token",
+    "format_mfu",
+    "StallDetector",
+    "NON_STEP_SEGMENTS",
+    "StepTimeline",
+    "annotate",
+    "window_stats",
+]

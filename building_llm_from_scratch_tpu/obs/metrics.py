@@ -1,0 +1,320 @@
+"""Structured training telemetry: counters/gauges/timings and a JSONL sink.
+
+The reference framework's only observability is per-module console logging
+and a post-hoc loss plot (utils.py:171-191). Production TPU runs need the
+numbers the systems literature treats as table stakes — per-step timing
+breakdowns, MFU, HBM usage — as machine-readable ARTIFACTS, not grepped
+logs. This module is the hub: one ``MetricLogger`` owns the JSONL file and
+every other layer (trainer, resilience, checkpoint, retry, weight fetch)
+reports through it.
+
+JSONL schema (one JSON object per line, ``type`` discriminates):
+
+  - ``header``  — exactly one, first line: run metadata (jax version,
+    device kind/count, process count, mesh shape, model config, argv,
+    parsed flags, schema_version).
+  - ``metrics`` — per-cadence numbers: ``step`` plus free-form scalar
+    fields (loss/lr/tok_s/mfu/step_time_s/memory gauges/...). ``step`` is
+    monotonically increasing across rows.
+  - ``event``   — typed structured events (``event`` names the kind:
+    checkpoint_save, checkpoint_fallback, preemption_stop, watchdog_halt,
+    retry, stall, ...), with free-form fields.
+
+One run = one file: if the path already holds a previous run's telemetry
+(a ``--resume auto`` relaunch reuses the same command), the old file is
+rotated aside (``.1``, ``.2``, ...) at first write, so every file keeps
+the header-first / monotone-step invariants.
+
+Coordinator-aware: by default only process 0 writes (the sink mirrors the
+reference's rank-0 gating for artifacts). The module-level singleton
+(``configure_metrics`` / ``get_metrics`` / ``emit_event``) lets deep layers
+emit events without plumbing a logger handle through every call — when
+nothing is configured, emission is a cheap no-op, so library use without a
+run context costs nothing.
+
+Writes are lock-guarded: the stall detector (obs/stall.py) emits from its
+watcher thread.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, IO, Optional
+
+from building_llm_from_scratch_tpu.utils.logging import setup_logger
+
+logger = setup_logger(__name__)
+
+SCHEMA_VERSION = 1
+
+
+def _is_coordinator() -> bool:
+    """Lazy coordinator check that never *initializes* jax: metrics must be
+    importable (and no-op usable) before ``jax.distributed.initialize``.
+    One implementation, shared with the log-gating filter — the metrics
+    sink and the console logs must never disagree about who writes."""
+    from building_llm_from_scratch_tpu.utils.logging import (
+        _coordinator_if_known,
+    )
+
+    return _coordinator_if_known()
+
+
+def _jsonable(value: Any) -> Any:
+    """Best-effort conversion to JSON-serializable values: numpy scalars
+    become python scalars, unknown objects become their repr — a telemetry
+    row must never crash the run it observes."""
+    if value is None or isinstance(value, (bool, int, str)):
+        return value
+    if isinstance(value, float):
+        # json rejects NaN/Inf under allow_nan=False; keep rows parseable
+        import math
+
+        return value if math.isfinite(value) else str(value)
+    if isinstance(value, dict):
+        return {str(k): _jsonable(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_jsonable(v) for v in value]
+    item = getattr(value, "item", None)
+    if callable(item):
+        try:
+            return _jsonable(item())
+        except Exception:
+            pass
+    return repr(value)
+
+
+class MetricLogger:
+    """Counters/gauges/timings plus a typed JSONL sink.
+
+    ``jsonl_path=None`` keeps the in-memory aggregation (counters survive
+    for tests/inspection) but writes nothing. All writes go through one
+    lock; rows are flushed immediately — a preempted run keeps every row
+    up to its last completed cadence.
+    """
+
+    def __init__(self, jsonl_path: Optional[str] = None,
+                 coordinator_only: bool = True):
+        self.jsonl_path = jsonl_path
+        self.coordinator_only = coordinator_only
+        self.counters: Dict[str, float] = {}
+        self.gauges: Dict[str, float] = {}
+        self._timings: Dict[str, float] = {}
+        # REENTRANT: GracefulStopper's signal handler emits an event, and
+        # the signal can land while THIS thread already holds the lock
+        # inside a write — a plain Lock would self-deadlock. Reentry is
+        # safe because every row is appended as one complete newline-
+        # terminated write, so an interleaved handler row never splits a
+        # line.
+        self._lock = threading.RLock()
+        self._file: Optional[IO[str]] = None
+        self._closed = False
+        self._header_written = False
+        # rows emitted before the header (build-time fetch/retry events —
+        # the run metadata needs the built components) are buffered and
+        # flushed right after it, keeping the header the first line
+        self._pre_header: list = []
+        self._last_step = -1
+
+    # -- aggregation -----------------------------------------------------
+
+    def count(self, name: str, n: float = 1) -> None:
+        """Monotonic counter (e.g. retries, checkpoints written)."""
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + n
+
+    def gauge(self, name: str, value: float) -> None:
+        """Last-value-wins gauge (e.g. bytes_in_use)."""
+        with self._lock:
+            self.gauges[name] = value
+
+    def timing(self, name: str, seconds: float) -> None:
+        """Accumulating timing bucket; drained into the next metrics row."""
+        with self._lock:
+            self._timings[name] = self._timings.get(name, 0.0) + seconds
+
+    # -- sink ------------------------------------------------------------
+
+    def _writable(self) -> bool:
+        # a closed sink stays closed: a late write (stall-detector thread
+        # firing during teardown) must not reopen the path — that would
+        # rotate the COMPLETED run's artifact aside for one stray row
+        if self.jsonl_path is None or self._closed:
+            return False
+        return not self.coordinator_only or _is_coordinator()
+
+    def _write_row(self, row: Dict[str, Any]) -> None:
+        """Append one row. Never raises: telemetry failure must not take
+        down the training loop it observes."""
+        if not self._writable():
+            return
+        try:
+            with self._lock:
+                if not self._header_written and row.get("type") != "header":
+                    self._pre_header.append(row)
+                    return
+                if self._file is None:
+                    d = os.path.dirname(self.jsonl_path)
+                    if d:
+                        os.makedirs(d, exist_ok=True)
+                    # one run = one file: a --resume auto relaunch reuses
+                    # the same path, and appending would put a second
+                    # header mid-file and restart the monotone step
+                    # sequence. Rotate the previous run's file aside
+                    # (.1, .2, ...) instead of truncating it — the killed
+                    # run's telemetry is exactly what a postmortem needs.
+                    if os.path.exists(self.jsonl_path) and os.path.getsize(
+                            self.jsonl_path) > 0:
+                        n = 1
+                        while os.path.exists(f"{self.jsonl_path}.{n}"):
+                            n += 1
+                        os.rename(self.jsonl_path, f"{self.jsonl_path}.{n}")
+                    self._file = open(self.jsonl_path, "a")
+                self._file.write(json.dumps(_jsonable(row)) + "\n")
+                self._file.flush()
+        except OSError as e:
+            logger.warning("Metrics sink write failed (%s); row dropped.", e)
+
+    def write_header(self, **metadata: Any) -> None:
+        row = {"type": "header", "time": time.time(),
+               "schema_version": SCHEMA_VERSION}
+        row.update(metadata)
+        with self._lock:
+            self._header_written = True
+            buffered, self._pre_header = self._pre_header, []
+        self._write_row(row)
+        for b in buffered:
+            self._write_row(b)
+
+    def log_metrics(self, step: int, **values: Any) -> None:
+        """One ``metrics`` row; merges and drains the timing buckets and
+        attaches current counters/gauges."""
+        with self._lock:
+            timings = {f"{k}_s": round(v, 6)
+                       for k, v in self._timings.items()}
+            self._timings.clear()
+            extra = dict(self.counters)
+            extra.update(self.gauges)
+        row = {"type": "metrics", "time": time.time(), "step": int(step)}
+        row.update(timings)
+        row.update(extra)
+        row.update(values)
+        if step < self._last_step:
+            logger.warning("Metrics row step went backwards (%d < %d)",
+                           step, self._last_step)
+        self._last_step = max(self._last_step, int(step))
+        self._write_row(row)
+
+    def event(self, kind: str, step: Optional[int] = None,
+              **fields: Any) -> None:
+        """One typed ``event`` row (also bumps the ``event:<kind>``
+        counter, so unconfigured library use still aggregates)."""
+        self.count(f"event:{kind}")
+        row = {"type": "event", "time": time.time(), "event": kind}
+        if step is not None:
+            row["step"] = int(step)
+        row.update(fields)
+        self._write_row(row)
+
+    def close(self) -> None:
+        # a run that dies before its header still keeps its buffered rows:
+        # a headerless telemetry file beats a silently empty one
+        if self._pre_header:
+            with self._lock:
+                self._header_written = True
+                buffered, self._pre_header = self._pre_header, []
+            for b in buffered:
+                self._write_row(b)
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+            self._closed = True
+
+
+# ---------------------------------------------------------------------------
+# Module-level singleton: deep layers emit without plumbing
+# ---------------------------------------------------------------------------
+
+_global_logger = MetricLogger(None)
+_atexit_registered = False
+
+
+def _close_global_at_exit() -> None:
+    # closes whatever logger is CURRENT at interpreter exit — registered
+    # once, so repeated configure_metrics calls (tests, multiple main()
+    # runs in one process) neither stack callbacks nor pin old loggers
+    _global_logger.close()
+
+
+def configure_metrics(jsonl_path: Optional[str],
+                      run_metadata: Optional[Dict[str, Any]] = None
+                      ) -> MetricLogger:
+    """Install the process-global MetricLogger (closing any previous one).
+    With ``run_metadata`` the header is written immediately; without it,
+    rows buffer until the caller's ``write_header`` (main.py configures
+    before component build so fetch/retry events are captured, then writes
+    the header once mesh + model metadata exist). ``jsonl_path=None``
+    resets to the no-op sink (tests use this to isolate)."""
+    global _global_logger, _atexit_registered
+    _global_logger.close()
+    _global_logger = MetricLogger(jsonl_path)
+    if jsonl_path is not None and not _atexit_registered:
+        # flush-at-exit makes the pre-header buffering promise real: if
+        # the run dies before its header (e.g. build_components exhausts
+        # its fetch retries and raises), the buffered retry/fetch events
+        # still land in a headerless file instead of vanishing. close()
+        # is idempotent, so the normal path is unaffected.
+        import atexit
+
+        atexit.register(_close_global_at_exit)
+        _atexit_registered = True
+    if jsonl_path is not None and run_metadata is not None:
+        _global_logger.write_header(**run_metadata)
+    return _global_logger
+
+
+def get_metrics() -> MetricLogger:
+    return _global_logger
+
+
+def emit_event(kind: str, step: Optional[int] = None, **fields: Any) -> None:
+    """Fire-and-forget structured event through the global logger. Safe to
+    call from any layer at any time (no-op sink when unconfigured)."""
+    _global_logger.event(kind, step=step, **fields)
+
+
+def run_metadata(args=None, cfg=None, plan=None) -> Dict[str, Any]:
+    """Assemble the header row's run metadata: jax version, device
+    kind/count, process count, mesh shape, model config, argv, flags.
+    Call AFTER ``initialize_distributed`` so the distributed view is real.
+    """
+    import dataclasses
+
+    import jax
+
+    devices = jax.devices()
+    meta: Dict[str, Any] = {
+        "jax_version": jax.__version__,
+        "backend": jax.default_backend(),
+        "device_kind": devices[0].device_kind if devices else "unknown",
+        "device_count": len(devices),
+        "local_device_count": jax.local_device_count(),
+        "process_count": jax.process_count(),
+        "process_index": jax.process_index(),
+        "argv": list(sys.argv),
+    }
+    if plan is not None and getattr(plan, "mesh", None) is not None:
+        meta["mesh_shape"] = {str(k): int(v)
+                              for k, v in plan.mesh.shape.items()}
+    else:
+        meta["mesh_shape"] = None
+    if cfg is not None:
+        meta["model"] = dataclasses.asdict(cfg)
+    if args is not None:
+        meta["flags"] = dict(vars(args))
+    return meta

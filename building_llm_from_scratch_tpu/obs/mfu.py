@@ -1,0 +1,100 @@
+"""Analytic model FLOPs + MFU against TPU-generation peak compute.
+
+MFU (model FLOPs utilization) is the throughput number the TPU systems
+literature reports (PaLM App. B; the Gemma-on-TPU and LoRAFusion comparison
+studies in PAPERS.md attribute wins the same way): achieved model FLOPs/s
+over the chip's peak, counting only the FLOPs the MODEL requires — remat
+recompute does not inflate it.
+
+FLOPs/token uses the standard decomposition:
+
+    6 * N_matmul  +  12 * n_layers * emb_dim * seq_len
+
+where ``N_matmul`` is the parameter count EXCLUDING embedding lookups
+(gathers, no FLOPs) but INCLUDING the output head, 6 = fwd(2) + bwd(4)
+multiply-accumulates per parameter per token, and the second term is the
+attention score/value matmuls (QK^T and AV, fwd+bwd, PaLM's ``12 L H Q T``
+convention — no causal discount).
+
+Peak FLOPs come from a small per-generation table keyed on
+``device.device_kind`` (bf16 dense peak per chip). Unknown kinds — CPU test
+meshes in particular — report ``None`` and the callers print "n/a" rather
+than a made-up number.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from building_llm_from_scratch_tpu.configs import ModelConfig
+
+#: bf16 dense peak FLOPs per CHIP, by device_kind substring (lowercased).
+#: Order matters: first match wins, so longer/more specific keys go first.
+TPU_PEAK_FLOPS = (
+    ("v6e", 918e12),         # Trillium
+    ("v6", 918e12),
+    ("v5p", 459e12),
+    ("v5e", 197e12),
+    ("v5 lite", 197e12),     # jax reports v5e as "TPU v5 lite"
+    ("v5litepod", 197e12),
+    ("v5", 459e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
+
+
+def flops_per_token(cfg: ModelConfig, seq_len: Optional[int] = None) -> int:
+    """Analytic train-step FLOPs per token (fwd+bwd) for this config."""
+    t = cfg.context_length if seq_len is None else seq_len
+    n_matmul = cfg.num_params(exclude_embeddings=True)
+    attention = 12 * cfg.n_layers * cfg.emb_dim * t
+    return 6 * n_matmul + attention
+
+
+def device_peak_flops(device=None) -> Optional[float]:
+    """Peak bf16 FLOPs for one chip, or None when unknown (CPU/GPU test
+    backends). Never initializes a backend the caller hasn't."""
+    if device is None:
+        try:
+            import jax
+
+            device = jax.local_devices()[0]
+        except Exception:
+            return None
+    kind = str(getattr(device, "device_kind", "")).lower()
+    if "tpu" not in kind and not kind.startswith("v"):
+        return None
+    for key, peak in TPU_PEAK_FLOPS:
+        if key in kind:
+            return peak
+    return None
+
+
+def compute_mfu(tokens_per_s: float, cfg: ModelConfig,
+                n_devices: Optional[int] = None,
+                peak: Optional[float] = None,
+                seq_len: Optional[int] = None) -> Optional[float]:
+    """MFU in [0, 1] for a measured throughput, or None when the peak is
+    unknown.
+
+    ``tokens_per_s`` and ``n_devices`` must describe the same scope: the
+    trainer passes its PER-PROCESS throughput with
+    ``jax.local_device_count()``, which equals the global ratio on
+    symmetric pods.
+    """
+    if peak is None:
+        peak = device_peak_flops()
+    if peak is None or tokens_per_s <= 0:
+        return None
+    if n_devices is None:
+        import jax
+
+        n_devices = jax.local_device_count()
+    achieved = tokens_per_s * flops_per_token(cfg, seq_len)
+    return achieved / (peak * max(1, n_devices))
+
+
+def format_mfu(mfu: Optional[float]) -> str:
+    """Log-line rendering: '41.4% MFU' or 'MFU n/a' off-TPU."""
+    return "MFU n/a" if mfu is None else f"{100.0 * mfu:.1f}% MFU"

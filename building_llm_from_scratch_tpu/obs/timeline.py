@@ -1,0 +1,122 @@
+"""Per-step wall-clock breakdown + profiler trace annotation.
+
+Two jobs, one API:
+
+  1. **Accounting** — the trainer's cadence window needs to know where the
+     wall-clock went: waiting on the data pipeline (``data_wait``),
+     dispatching the jitted step (``dispatch`` — NOT execution: steps are
+     async), blocking host fetches (``host_fetch``), and the non-step
+     cadence work (``eval``/``sample``/``checkpoint``) whose time must be
+     EXCLUDED from tok/s so the reported throughput measures training, not
+     sampling (ISSUE-2 satellite: the old ``t_tokens/t_start`` window
+     deflated tok/s whenever a sample or save fired inside it).
+  2. **Navigability** — the same spans become ``jax.profiler``
+     ``TraceAnnotation`` blocks, and each train step gets a
+     ``StepTraceAnnotation``, so a ``--profile`` xplane capture shows named
+     regions instead of an undifferentiated op soup.
+
+Annotations are no-ops when no trace is active (jax makes them ~free), so
+the spans stay on permanently — they are NOT gated on ``--profile``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, Iterator, Optional
+
+#: Segments excluded from the throughput window: host-side cadence work
+#: that is not training (the step loop is paused, not slow).
+NON_STEP_SEGMENTS = ("eval", "sample", "checkpoint")
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named ``jax.profiler.TraceAnnotation`` span (degrades to a no-op if
+    the profiler API is unavailable)."""
+    try:
+        import jax
+
+        ctx = jax.profiler.TraceAnnotation(name)
+    except Exception:
+        ctx = contextlib.nullcontext()
+    with ctx:
+        yield
+
+
+class StepTimeline:
+    """Accumulates named wall-clock segments between ``drain()`` calls.
+
+    The trainer drains once per logging cadence; the returned dict is the
+    window's breakdown in seconds. Spans double as profiler trace
+    annotations (see module docstring).
+    """
+
+    def __init__(self):
+        self.seconds: Dict[str, float] = {}
+        self.steps_in_window = 0
+
+    def add(self, segment: str, dt: float) -> None:
+        self.seconds[segment] = self.seconds.get(segment, 0.0) + dt
+
+    @contextlib.contextmanager
+    def span(self, segment: str) -> Iterator[None]:
+        """Time a block into ``segment`` and annotate it in the trace."""
+        t0 = time.perf_counter()
+        try:
+            with annotate(segment):
+                yield
+        finally:
+            self.add(segment, time.perf_counter() - t0)
+
+    @contextlib.contextmanager
+    def step_span(self, step_num: int) -> Iterator[None]:
+        """One train step: ``StepTraceAnnotation`` (so xplane groups ops
+        per step) + ``dispatch`` accounting. The measured time is DISPATCH
+        latency — jitted steps return before the device finishes; the
+        execution catch-up is visible as ``host_fetch`` at cadence."""
+        t0 = time.perf_counter()
+        try:
+            import jax
+
+            ctx = jax.profiler.StepTraceAnnotation("train",
+                                                   step_num=step_num)
+        except Exception:
+            ctx = contextlib.nullcontext()
+        try:
+            with ctx:
+                yield
+        finally:
+            self.add("dispatch", time.perf_counter() - t0)
+            self.steps_in_window += 1
+
+    def non_step_seconds(self) -> float:
+        return sum(self.seconds.get(k, 0.0) for k in NON_STEP_SEGMENTS)
+
+    def drain(self) -> Dict[str, float]:
+        """Return and reset the current window's breakdown. The dict also
+        carries ``steps`` (train steps dispatched in the window)."""
+        out = dict(self.seconds)
+        out["steps"] = self.steps_in_window
+        self.seconds = {}
+        self.steps_in_window = 0
+        return out
+
+
+def window_stats(window: Dict[str, float], elapsed: float,
+                 tokens: int) -> Dict[str, Optional[float]]:
+    """Throughput/step-time numbers for one drained cadence window.
+
+    ``elapsed`` is the full wall-clock since the window opened; the
+    non-step segments (eval/sample/checkpoint) are subtracted so tok/s and
+    step_time measure the training loop only.
+    """
+    non_step = sum(window.get(k, 0.0) for k in NON_STEP_SEGMENTS)
+    step_seconds = max(elapsed - non_step, 0.0)
+    steps = int(window.get("steps", 0))
+    return {
+        "tok_s": tokens / step_seconds if step_seconds > 0 else 0.0,
+        "step_time_s": step_seconds / steps if steps else None,
+        "step_seconds": step_seconds,
+        "non_step_seconds": non_step,
+    }

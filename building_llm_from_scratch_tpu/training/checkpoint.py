@@ -38,11 +38,13 @@ from __future__ import annotations
 
 import json
 import os
+import time
 from typing import Any, Dict, Optional
 
 import jax
 import numpy as np
 
+from building_llm_from_scratch_tpu.obs.metrics import emit_event
 from building_llm_from_scratch_tpu.utils.logging import setup_logger
 
 logger = setup_logger(__name__)
@@ -178,6 +180,7 @@ def save_checkpoint(ckpt_dir: str, state: Params,
     transparently fall back to them (``_resolve_ckpt_dir``), so no commit
     ordering loses a restorable checkpoint.
     """
+    t_save = time.perf_counter()
     is_proc0 = jax.process_index() == 0
     leaves = jax.tree_util.tree_flatten_with_path(state)[0]
     tmp_dir = ckpt_dir.rstrip("/") + ".tmp"
@@ -285,6 +288,15 @@ def save_checkpoint(ckpt_dir: str, state: Params,
     # no process returns (and e.g. immediately resaves the same tag or
     # resumes from it) before the commit rename is visible
     _barrier(f"ckpt_commit:{ckpt_dir}")
+    # structured telemetry: the coordinator's manifest carries every
+    # shard's size, so total bytes come for free (other hosts report None
+    # rather than a partial local sum)
+    total_bytes = (sum(int(sh.get("bytes", 0)) for leaf in manifest["leaves"]
+                       for sh in leaf["shards"]) if is_proc0 else None)
+    emit_event("checkpoint_save", path=ckpt_dir,
+               step=(extra_metadata or {}).get("global_step"),
+               seconds=round(time.perf_counter() - t_save, 4),
+               bytes=total_bytes, leaves=len(manifest["leaves"]))
     return ckpt_dir
 
 
@@ -441,6 +453,7 @@ def load_checkpoint(ckpt_dir: str, template_state: Params,
     Handles both the sharded-v1 format and the round-3 gathered format
     (full ``leaf_NNNNN.npy`` files).
     """
+    t_load = time.perf_counter()
     resolved = _resolve_ckpt_dir(ckpt_dir)
     if resolved == ckpt_dir:
         _cleanup_stale_siblings(ckpt_dir)
@@ -508,6 +521,10 @@ def load_checkpoint(ckpt_dir: str, template_state: Params,
             loaded.append(jax.device_put(arr, shard))
         else:
             loaded.append(jax.device_put(arr))
+    emit_event("checkpoint_restore", path=ckpt_dir,
+               step=manifest.get("metadata", {}).get("global_step"),
+               seconds=round(time.perf_counter() - t_load, 4),
+               leaves=len(manifest["leaves"]))
     return jax.tree_util.tree_unflatten(treedef, loaded)
 
 

@@ -37,6 +37,7 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
+from building_llm_from_scratch_tpu.obs.metrics import emit_event
 from building_llm_from_scratch_tpu.utils.logging import setup_logger
 
 logger = setup_logger(__name__)
@@ -89,6 +90,8 @@ class GracefulStopper:
                 raise KeyboardInterrupt
             self._sigint_seen = True
         self.requested = True
+        emit_event("preemption_signal",
+                   signal=signal.Signals(signum).name)
         logger.warning(
             "Received %s: will checkpoint and stop at the next step "
             "boundary (send SIGINT again to abort immediately).",
@@ -228,6 +231,8 @@ def find_latest_valid_checkpoint(output_dir: str) -> Optional[str]:
         reason = validate_checkpoint(path)
         if reason is None:
             return path
+        emit_event("checkpoint_fallback", step=step, path=path,
+                   reason=reason)
         logger.error(
             "Checkpoint %s (step %d) is INVALID: %s — falling back to the "
             "previous checkpoint.", path, step, reason)
@@ -319,6 +324,9 @@ def prune_checkpoints(output_dir: str, keep: int) -> List[str]:
                 if not suffix:
                     removed.append(path)
     if removed:
+        emit_event("checkpoint_gc",
+                   removed=[os.path.basename(p) for p in removed],
+                   keep=keep)
         logger.info("Retention GC: removed %d old checkpoint(s): %s",
                     len(removed), ", ".join(os.path.basename(p)
                                             for p in removed))
@@ -354,6 +362,8 @@ class LossWatchdog:
 
     def observe(self, step: int, loss: float) -> None:
         if self.check_finite and not np.isfinite(loss):
+            emit_event("watchdog_halt", step=step, loss=float(loss),
+                       reason="non_finite", recent=self._tail())
             raise TrainingDivergedError(
                 f"Train loss became non-finite ({loss}) by step {step}. "
                 f"Recent losses: {self._tail()}. The model has diverged — "
@@ -363,6 +373,10 @@ class LossWatchdog:
             median = float(np.median(self._history))
             if np.isfinite(loss) and loss > self.spike_factor * max(
                     median, 1e-8):
+                emit_event("watchdog_halt", step=step, loss=float(loss),
+                           reason="spike", median=median,
+                           spike_factor=self.spike_factor,
+                           recent=self._tail())
                 raise TrainingDivergedError(
                     f"Train loss {loss:.4f} at step {step} spiked above "
                     f"{self.spike_factor:g}x the running median "

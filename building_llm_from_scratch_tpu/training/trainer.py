@@ -19,7 +19,15 @@ TPU-first differences:
   - fault tolerance (training/resilience.py): SIGTERM/SIGINT checkpoint-
     and-stop at the step boundary, a data cursor in checkpoint metadata so
     resume fast-forwards to the exact mid-epoch batch, --keep_ckpts
-    retention GC, and an optional loss watchdog that halts on divergence.
+    retention GC, and an optional loss watchdog that halts on divergence;
+  - observability (obs/): a StepTimeline breaks each cadence window into
+    data_wait/dispatch/host_fetch plus excluded eval/sample/checkpoint
+    segments (so tok/s measures training, not cadence work), every span
+    doubles as a profiler trace annotation, metric rows (loss/lr/tok_s/
+    MFU/step-time/memory) land in the --metrics_jsonl sink at --log_every
+    cadence, and an optional per-host stall detector gets one heartbeat
+    per step-loop iteration. The deferred-fetch discipline is unchanged:
+    device scalars are still only fetched at cadence (_flush_metrics).
 """
 
 from __future__ import annotations
@@ -38,6 +46,13 @@ from building_llm_from_scratch_tpu.generate import (
     token_ids_to_text,
 )
 from building_llm_from_scratch_tpu.models.lora import merge_lora
+from building_llm_from_scratch_tpu.obs import (
+    StepTimeline,
+    compute_mfu,
+    format_mfu,
+    get_metrics,
+    window_stats,
+)
 from building_llm_from_scratch_tpu.training.checkpoint import (
     checkpoint_metadata,
     export_params,
@@ -65,6 +80,10 @@ from building_llm_from_scratch_tpu.utils.io import (
     read_text_file,
 )
 from building_llm_from_scratch_tpu.utils.logging import setup_logger
+from building_llm_from_scratch_tpu.utils.memory import (
+    device_memory_stats,
+    host_rss_bytes,
+)
 
 logger = setup_logger(__name__)
 
@@ -92,7 +111,9 @@ class Trainer:
                  show_progress: bool = True,
                  keep_ckpts: int = 0,
                  watchdog: Optional[LossWatchdog] = None,
-                 stopper: Optional[GracefulStopper] = None):
+                 stopper: Optional[GracefulStopper] = None,
+                 log_every: int = 0,
+                 stall=None):
         self.cfg = cfg
         self.tokenizer = tokenizer
         self.loader = loader
@@ -120,6 +141,14 @@ class Trainer:
         self.keep_ckpts = keep_ckpts
         self.watchdog = watchdog
         self.stopper = stopper
+        # observability (obs/): metrics cadence decoupled from eval
+        # (--log_every; 0 keeps the historical eval-cadence behavior), a
+        # wall-clock timeline whose spans double as profiler trace
+        # annotations, an optional JSONL sink, and an optional per-host
+        # stall detector heartbeated once per step-loop iteration
+        self.log_every = log_every
+        self.stall = stall
+        self.timeline = StepTimeline()
         # (epoch, file_index, batch_index) of the NEXT batch to train —
         # written into checkpoint metadata so resume fast-forwards the
         # deterministic shuffled loader to the exact mid-epoch position
@@ -147,6 +176,16 @@ class Trainer:
         self._pending_lrs: List[Any] = []
         self.track_tokens_seen: List[int] = []
         self.throughput_tokens_per_s: List[float] = []
+
+    @property
+    def metrics_sink(self):
+        """The structured-metrics sink: always the PROCESS-GLOBAL logger
+        (resolved per call, so late ``configure_metrics`` wins), never an
+        injected one — checkpoint/resilience/retry layers emit through the
+        same global, and a trainer-private sink would split the event
+        trail across two files. Always non-None: unconfigured use gets
+        the no-op sink."""
+        return get_metrics()
 
     # ------------------------------------------------------------------
     # Setup
@@ -413,7 +452,8 @@ class Trainer:
         cheaply — batches materialize lazily)."""
         if self.warmup_sample and self.global_step == 0:
             # warm-up sample before the first step (reference main.py:143-145)
-            self.generate_and_print_sample(start_context)
+            with self.timeline.span("sample"):
+                self.generate_and_print_sample(start_context)
             self.warmup_sample = False
         if self.profile_dir is not None and not self._profiling:
             # --profile: jax.profiler trace of the first training steps
@@ -421,7 +461,13 @@ class Trainer:
             jax.profiler.start_trace(self.profile_dir)
             self._profiling = True
             self._profile_stop_at = self.global_step + self.profile_steps
+        # discard timeline segments accumulated outside any window (warmup
+        # sample above, the previous file's trailing cadence work): the
+        # window that opens at t_start below must only subtract non-step
+        # time that actually fell inside it
+        self.timeline.drain()
         t_tokens, t_start = 0, time.perf_counter()
+        log_cadence = self.log_every if self.log_every > 0 else self.eval_freq
         batches = train_batches_fn(epoch)
         if skip_batches:
             import itertools
@@ -437,9 +483,18 @@ class Trainer:
             batches = tqdm(batches, total=n_batches, desc=desc,
                            unit="batch", leave=False)
         batch_in_file = skip_batches
-        for arrays in batches:
+        batches_iter = iter(batches)
+        while True:
+            # explicit next() so the wait on the data pipeline is its own
+            # timeline segment (and trace span) instead of vanishing into
+            # the loop header
+            with self.timeline.span("data_wait"):
+                arrays = next(batches_iter, None)
+            if arrays is None:
+                break
             batch = self._device_batch(arrays)
-            self.state, metrics = self.train_step(self.state, batch)
+            with self.timeline.step_span(self.global_step + 1):
+                self.state, metrics = self.train_step(self.state, batch)
             self.global_step += 1
             batch_in_file += 1
             self._cursor = {"epoch": epoch, "file_index": file_index,
@@ -476,32 +531,78 @@ class Trainer:
                 logger.info("Profiler trace captured (%d steps)",
                             self.profile_steps)
 
-            if self.global_step % self.eval_freq == 0:
+            at_eval = self.global_step % self.eval_freq == 0
+            if at_eval or self.global_step % log_cadence == 0:
                 # flush FIRST: float() on the last pending lr blocks until
-                # the final dispatched step finishes, so `elapsed` measures
-                # execution, not async dispatch
-                self._flush_metrics()
+                # the final dispatched step finishes, so the window
+                # measures execution, not async dispatch (the blocking
+                # catch-up shows up as the host_fetch segment)
+                with self.timeline.span("host_fetch"):
+                    self._flush_metrics()
                 elapsed = time.perf_counter() - t_start
-                tps = t_tokens / elapsed if elapsed > 0 else 0.0
+                window = self.timeline.drain()
+                stats = window_stats(window, elapsed, t_tokens)
+                tps = stats["tok_s"]
                 self.throughput_tokens_per_s.append(tps)
-                train_loss, val_loss = self.evaluate_model(
-                    train_batches_fn(epoch), val_batches_fn(epoch))
-                self.train_losses.append(train_loss)
-                self.val_losses.append(val_loss)
-                self.track_tokens_seen.append(self.tokens_seen)
-                logger.info(
-                    "step %d: train %.3f, val %.3f, lr %.2e, %.0f tok/s",
-                    self.global_step, train_loss, val_loss,
-                    self.track_lrs[-1], tps)
+                # the window reopens HERE: the eval below (and any
+                # sample/checkpoint cadence after it) runs inside the new
+                # window but lands in excluded timeline segments, so the
+                # next tok/s measures training time only — the old
+                # t_tokens/t_start accounting charged sample+save time to
+                # the throughput window and deflated it
                 t_tokens, t_start = 0, time.perf_counter()
+                mfu = compute_mfu(tps, self.cfg)
+                row = {
+                    "lr": self.track_lrs[-1] if self.track_lrs else None,
+                    "tokens_seen": self.tokens_seen,
+                    "tok_s": round(tps, 1),
+                    "mfu": mfu,
+                    "step_time_s": stats["step_time_s"],
+                    "data_wait_s": round(window.get("data_wait", 0.0), 6),
+                    "dispatch_s": round(window.get("dispatch", 0.0), 6),
+                    "host_fetch_s": round(window.get("host_fetch", 0.0), 6),
+                    "steps_in_window": int(window.get("steps", 0)),
+                }
+                dev_mem = device_memory_stats()
+                if dev_mem:
+                    row["hbm_bytes_in_use"] = dev_mem.get("bytes_in_use")
+                    row["hbm_peak_bytes"] = dev_mem.get("peak_bytes_in_use")
+                rss = host_rss_bytes()
+                if rss is not None:
+                    row["host_rss_bytes"] = rss
+                if at_eval:
+                    with self.timeline.span("eval"):
+                        train_loss, val_loss = self.evaluate_model(
+                            train_batches_fn(epoch), val_batches_fn(epoch))
+                    self.train_losses.append(train_loss)
+                    self.val_losses.append(val_loss)
+                    self.track_tokens_seen.append(self.tokens_seen)
+                    row["train_loss"] = train_loss
+                    row["val_loss"] = val_loss
+                    logger.info(
+                        "step %d: train %.3f, val %.3f, lr %.2e, "
+                        "%.0f tok/s, %s",
+                        self.global_step, train_loss, val_loss,
+                        self.track_lrs[-1], tps, format_mfu(mfu))
+                else:
+                    logger.info(
+                        "step %d: lr %.2e, %.0f tok/s, %s, "
+                        "step %.1fms (data_wait %.1fms)",
+                        self.global_step, self.track_lrs[-1], tps,
+                        format_mfu(mfu),
+                        1e3 * (stats["step_time_s"] or 0.0),
+                        1e3 * window.get("data_wait", 0.0))
+                self.metrics_sink.log_metrics(self.global_step, **row)
 
             if self.global_step % self.print_sample_iter == 0:
-                self.generate_and_print_sample(start_context)
+                with self.timeline.span("sample"):
+                    self.generate_and_print_sample(start_context)
 
             if self.global_step % self.save_ckpt_freq == 0:
-                self.save_checkpoint(str(self.global_step),
-                                     cursor=self._cursor)
-                self._prune_old_checkpoints()
+                with self.timeline.span("checkpoint"):
+                    self.save_checkpoint(str(self.global_step),
+                                         cursor=self._cursor)
+                    self._prune_old_checkpoints()
 
             if self.stopper is not None and self.stopper.should_stop():
                 # preemption-safe stop at the step boundary: the signal was
@@ -512,9 +613,19 @@ class Trainer:
                 logger.warning(
                     "Graceful stop requested: writing checkpoint at step "
                     "%d and exiting.", self.global_step)
-                self.save_checkpoint("interrupted", cursor=self._cursor)
+                self.metrics_sink.event("preemption_stop",
+                                        step=self.global_step,
+                                        tokens_seen=self.tokens_seen)
+                with self.timeline.span("checkpoint"):
+                    self.save_checkpoint("interrupted", cursor=self._cursor)
                 self.preempted = True
                 raise PreemptionStop
+
+            if self.stall is not None:
+                # one heartbeat per step-loop iteration: if the loop wedges
+                # anywhere (collective, data pipeline, host fetch), the
+                # per-host detector dumps stacks after its timeout
+                self.stall.notify_step()
 
     def _flush_metrics(self, check_watchdog: bool = True):
         """Fetch pending per-step device metrics to host floats. Per-scalar

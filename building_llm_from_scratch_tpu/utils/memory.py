@@ -11,6 +11,7 @@ test runs stats may be unavailable and we degrade gracefully.
 
 from __future__ import annotations
 
+import sys
 from typing import Any, Dict, Optional
 
 import jax
@@ -39,6 +40,29 @@ def estimate_memory_dynamic(n_params: int, n_trainable: int,
     parameters + gradients-for-trainables + buffers; this framework keeps
     no torch-style buffers — RoPE/mask constants live in the jit program)."""
     return (n_params + n_trainable) * DTYPE_BYTES[dtype] / 1024**3
+
+
+def host_rss_bytes() -> Optional[int]:
+    """This process's resident set size in bytes, or None when
+    undeterminable. Host-RAM growth (data pipeline buffers, checkpoint
+    staging, metric accumulation) is invisible to ``device.memory_stats``
+    — a leaking input pipeline OOMs the HOST first. Reads /proc (Linux,
+    the TPU VM case) and falls back to getrusage peak-RSS elsewhere."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    try:
+        import resource
+
+        peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        # linux reports KiB, macOS bytes
+        return peak * 1024 if sys.platform != "darwin" else peak
+    except Exception:
+        return None
 
 
 def device_memory_stats(device: Optional[jax.Device] = None) -> Dict[str, int]:

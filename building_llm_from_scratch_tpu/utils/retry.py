@@ -98,6 +98,14 @@ def with_retries(fn: Callable[[], T], *, attempts: int = 3,
                 raise
             delay = min(max_delay, base_delay * (2 ** attempt))
             delay += rng() * delay
+            # structured telemetry (obs/metrics.py): imported lazily so the
+            # retry helper stays importable with zero obs dependencies
+            from building_llm_from_scratch_tpu.obs.metrics import emit_event
+
+            emit_event("retry", describe=describe,
+                       error=f"{type(e).__name__}: {e}",
+                       attempt=attempt + 1, attempts=attempts,
+                       delay_s=round(delay, 2))
             logger.warning(
                 "%s failed (%s: %s); retrying in %.1fs (attempt %d/%d)",
                 describe, type(e).__name__, e, delay, attempt + 1, attempts)

@@ -27,6 +27,7 @@ import json
 import os
 import pickle
 import struct
+import time
 import zipfile
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -273,13 +274,26 @@ def _resolve_files(repo_id: str, filenames: List[str],
         return paths
     from huggingface_hub import hf_hub_download
 
+    from building_llm_from_scratch_tpu.obs.metrics import emit_event
     from building_llm_from_scratch_tpu.utils.retry import with_retries
 
-    return [with_retries(
+    t0 = time.perf_counter()
+    t0_wall = time.time()
+    paths = [with_retries(
                 lambda f=f: hf_hub_download(repo_id=repo_id, filename=f,
                                             cache_dir=cache_dir),
                 describe=f"download {repo_id}/{f}")
-            for f in filenames]
+             for f in filenames]
+    # bytes = what actually crossed the network THIS call: files whose
+    # mtime predates the call were cache hits, and counting them would
+    # make a warm-cache relaunch look like a multi-GB download
+    fetched = [p for p in paths if os.path.exists(p)
+               and os.path.getmtime(p) >= t0_wall - 1.0]
+    emit_event("hf_fetch", repo=repo_id, files=filenames,
+               bytes=sum(os.path.getsize(p) for p in fetched),
+               cached=len(paths) - len(fetched),
+               seconds=round(time.perf_counter() - t0, 3))
+    return paths
 
 
 def _repo_files(model: str, num_params: str) -> Tuple[str, List[str], str]:

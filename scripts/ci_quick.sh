@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# Quick CI gate: lint (when ruff is installed) + the tier-1 test command
+# from ROADMAP.md. Keeps the obs/ package and the metrics JSONL schema
+# importable and lint-clean on every change.
+#
+#   bash scripts/ci_quick.sh [extra pytest args...]
+set -uo pipefail
+
+cd "$(dirname "$0")/.."
+
+if command -v ruff >/dev/null 2>&1; then
+    echo "== ruff check =="
+    ruff check . || exit 1
+else
+    echo "== ruff not installed; skipping lint =="
+fi
+
+echo "== import smoke =="
+JAX_PLATFORMS=cpu python -c "
+import building_llm_from_scratch_tpu.obs as obs
+from building_llm_from_scratch_tpu.obs.metrics import SCHEMA_VERSION
+from building_llm_from_scratch_tpu.args import get_args
+print('obs import ok, metrics schema v%d' % SCHEMA_VERSION)
+" || exit 1
+
+echo "== tier-1 tests (ROADMAP.md) =="
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly "$@" 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+exit $rc

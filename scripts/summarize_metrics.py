@@ -1,0 +1,156 @@
+"""Render a --metrics_jsonl telemetry file: loss/throughput/MFU/memory
+curves + a text summary. Replaces the old single-purpose loss plot
+(utils/plotting.py) as the post-hoc view of a run — the JSONL is the
+artifact, this is just one renderer over it.
+
+  python scripts/summarize_metrics.py out/metrics.jsonl [--out out/metrics.png]
+
+Prints the run header, per-event-kind counts, and final/peak numbers to
+stdout; writes a 2x2 figure (train/val loss, tok/s, MFU, memory) when
+matplotlib is available (text summary still works without it).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+
+def load_rows(path):
+    header, metrics, events = None, [], []
+    with open(path) as f:
+        for i, line in enumerate(f):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError as e:
+                print(f"warning: line {i + 1} unparseable ({e}); skipped",
+                      file=sys.stderr)
+                continue
+            kind = row.get("type")
+            if kind == "header":
+                header = row
+            elif kind == "metrics":
+                metrics.append(row)
+            elif kind == "event":
+                events.append(row)
+    return header, metrics, events
+
+
+def column(rows, key):
+    """(steps, values) for rows where ``key`` is a number."""
+    pairs = [(r["step"], r[key]) for r in rows
+             if isinstance(r.get(key), (int, float))]
+    return [p[0] for p in pairs], [p[1] for p in pairs]
+
+
+def summarize(header, metrics, events):
+    if header:
+        mesh = header.get("mesh_shape")
+        model = (header.get("model") or {}).get("name", "?")
+        print(f"run: model={model} jax={header.get('jax_version')} "
+              f"devices={header.get('device_count')}x"
+              f"{header.get('device_kind')} mesh={mesh}")
+    print(f"{len(metrics)} metric rows, {len(events)} events")
+    by_kind = {}
+    for e in events:
+        by_kind[e["event"]] = by_kind.get(e["event"], 0) + 1
+    if by_kind:
+        print("events:", ", ".join(f"{k} x{v}"
+                                   for k, v in sorted(by_kind.items())))
+    if not metrics:
+        return
+    last = metrics[-1]
+    steps, tok_s = column(metrics, "tok_s")
+    _, train = column(metrics, "train_loss")
+    _, mfu = column(metrics, "mfu")
+    _, hbm = column(metrics, "hbm_peak_bytes")
+    print(f"final: step={last.get('step')} "
+          f"tokens_seen={last.get('tokens_seen')} "
+          f"train_loss={train[-1] if train else 'n/a'}")
+    if tok_s:
+        print(f"throughput: last={tok_s[-1]:.0f} tok/s "
+              f"peak={max(tok_s):.0f} mean={sum(tok_s) / len(tok_s):.0f}")
+    if mfu:
+        print(f"mfu: last={100 * mfu[-1]:.1f}% peak={100 * max(mfu):.1f}%")
+    else:
+        print("mfu: n/a (no TPU peak-FLOPs entry for this device kind)")
+    if hbm:
+        print(f"peak HBM: {max(hbm) / 1024**3:.2f} GiB")
+    ckpt = [e for e in events if e["event"] == "checkpoint_save"
+            and isinstance(e.get("seconds"), (int, float))]
+    if ckpt:
+        secs = [e["seconds"] for e in ckpt]
+        print(f"checkpoints: {len(ckpt)} saves, "
+              f"mean {sum(secs) / len(secs):.2f}s, max {max(secs):.2f}s")
+
+
+def plot(metrics, out_path):
+    try:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+    except ImportError:
+        print("matplotlib unavailable; skipping figure", file=sys.stderr)
+        return None
+    fig, axes = plt.subplots(2, 2, figsize=(11, 7))
+    (ax_loss, ax_tps), (ax_mfu, ax_mem) = axes
+
+    s, train = column(metrics, "train_loss")
+    sv, val = column(metrics, "val_loss")
+    ax_loss.plot(s, train, label="train")
+    ax_loss.plot(sv, val, linestyle="-.", label="val")
+    ax_loss.set_title("loss")
+    ax_loss.legend()
+
+    s, tps = column(metrics, "tok_s")
+    ax_tps.plot(s, tps)
+    ax_tps.set_title("throughput (tok/s, non-step time excluded)")
+
+    s, mfu = column(metrics, "mfu")
+    if mfu:
+        ax_mfu.plot(s, [100 * m for m in mfu])
+        ax_mfu.set_title("MFU (%)")
+    else:
+        ax_mfu.set_title("MFU n/a (unknown device peak)")
+
+    for key, label in (("hbm_bytes_in_use", "HBM in use"),
+                       ("hbm_peak_bytes", "HBM peak"),
+                       ("host_rss_bytes", "host RSS")):
+        s, mem = column(metrics, key)
+        if mem:
+            ax_mem.plot(s, [m / 1024**3 for m in mem], label=label)
+    ax_mem.set_title("memory (GiB)")
+    ax_mem.legend()
+
+    for ax in axes.flat:
+        ax.set_xlabel("step")
+    fig.tight_layout()
+    d = os.path.dirname(out_path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    fig.savefig(out_path)
+    plt.close(fig)
+    print(f"figure written to {out_path}")
+    return out_path
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("jsonl", help="metrics JSONL written by --metrics_jsonl")
+    p.add_argument("--out", default=None,
+                   help="figure path (default: <jsonl dir>/metrics.png)")
+    args = p.parse_args(argv)
+    header, metrics, events = load_rows(args.jsonl)
+    summarize(header, metrics, events)
+    if metrics:
+        out = args.out or os.path.join(
+            os.path.dirname(os.path.abspath(args.jsonl)), "metrics.png")
+        plot(metrics, out)
+
+
+if __name__ == "__main__":
+    main()

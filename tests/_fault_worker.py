@@ -30,6 +30,10 @@ def main():
         "--batch_size", "4", "--eval_freq", "10",
         "--print_sample_iter", "100000", "--save_ckpt_freq", "5",
         "--warmup_steps", "2", "--keep_ckpts", "2",
+        # structured telemetry: the parent test asserts the preemption +
+        # checkpoint events landed in the sink (rows flush per write, so
+        # the file is complete even though this process gets SIGTERMed)
+        "--metrics_jsonl", os.path.join(out_dir, "metrics.jsonl"),
     ])
     trainer = run_main(args)
     print(f"WORKER_EXIT preempted={trainer.preempted} "

@@ -92,6 +92,20 @@ def test_sigterm_preemption_then_auto_resume_matches_uninterrupted(tmp_path):
     assert os.path.isfile(os.path.join(interrupted, "manifest.json")), out
     # retention GC ran in the worker too
     assert len(_step_tagged(out_kill)) <= 2, _step_tagged(out_kill)
+    # the resilience actions left a structured event trail in the metrics
+    # sink (obs/): the SIGTERM signal, the step-boundary stop, and the
+    # interrupted checkpoint's save — with the header as the first row
+    import json as _json
+
+    with open(os.path.join(out_kill, "metrics.jsonl")) as f:
+        rows = [_json.loads(line) for line in f if line.strip()]
+    assert rows[0]["type"] == "header"
+    events = {r["event"] for r in rows if r["type"] == "event"}
+    assert {"preemption_signal", "preemption_stop",
+            "checkpoint_save"} <= events, events
+    assert any(r.get("event") == "checkpoint_save"
+               and r["path"].endswith("model_pg_interrupted")
+               for r in rows), events
 
     # 3. relaunch with the SAME command: --resume auto (the default) must
     #    discover the interrupted checkpoint, fast-forward the data cursor,

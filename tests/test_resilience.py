@@ -405,6 +405,79 @@ class StopAfter(GracefulStopper):
         return self.after <= 0
 
 
+# ---------------------------------------------------------------------------
+# Structured telemetry: resilience actions land in the metrics sink
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def event_sink(tmp_path):
+    """Route the global metrics sink to a tmp JSONL so the fault paths'
+    emit_event calls become observable, restoring the no-op sink after."""
+    from building_llm_from_scratch_tpu.obs import configure_metrics
+
+    path = str(tmp_path / "events.jsonl")
+    configure_metrics(path, run_metadata={"test": True})
+    yield path
+    configure_metrics(None)
+
+
+def _events(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f
+                if json.loads(line).get("type") == "event"]
+
+
+def test_checkpoint_fallback_emits_event(tmp_path, event_sink):
+    out = str(tmp_path)
+    _save(out, "10", 10)
+    ck20 = _save(out, "20", 20)
+    _flip_byte(_first_shard(ck20))
+    assert find_latest_valid_checkpoint(out).endswith("model_pg_10")
+    ev = [e for e in _events(event_sink) if e["event"] == "checkpoint_fallback"]
+    assert ev and ev[0]["step"] == 20 and "sha256" in ev[0]["reason"]
+
+
+def test_checkpoint_save_and_gc_emit_events(tmp_path, event_sink):
+    out = str(tmp_path)
+    for step in (1, 2, 3):
+        _save(out, str(step), step)
+    prune_checkpoints(out, keep=1)
+    events = _events(event_sink)
+    saves = [e for e in events if e["event"] == "checkpoint_save"]
+    assert len(saves) == 3
+    assert all(e["bytes"] > 0 and e["seconds"] >= 0 for e in saves)
+    gc = [e for e in events if e["event"] == "checkpoint_gc"]
+    assert gc and sorted(gc[0]["removed"]) == ["model_pg_1", "model_pg_2"]
+
+
+def test_watchdog_halt_emits_event(event_sink):
+    wd = LossWatchdog(spike_factor=5.0, window=10, min_history=2)
+    wd.observe(0, 2.0)
+    wd.observe(1, 2.0)
+    with pytest.raises(TrainingDivergedError):
+        wd.observe(2, 99.0)
+    ev = [e for e in _events(event_sink) if e["event"] == "watchdog_halt"]
+    assert ev and ev[0]["reason"] == "spike" and ev[0]["step"] == 2
+
+
+def test_preemption_stop_emits_event(tmp_path, event_sink):
+    """The graceful-stop path reports itself: a preemption_stop event plus
+    the interrupted checkpoint's save event."""
+    cfg = tiny_cfg()
+    datafile = tmp_path / "c.txt"
+    datafile.write_text("the quick brown fox jumps over the lazy dog. " * 12)
+    trainer = make_trainer(tmp_path, init_params(cfg, jax.random.PRNGKey(0)),
+                           stopper=StopAfter(3))
+    trainer.train_model([str(datafile)], n_epochs=1, start_context="a")
+    assert trainer.preempted and trainer.global_step == 3
+    events = _events(event_sink)
+    stop = [e for e in events if e["event"] == "preemption_stop"]
+    assert stop and stop[0]["step"] == 3
+    assert any(e["event"] == "checkpoint_save"
+               and e["path"].endswith("model_pg_interrupted")
+               for e in events)
+
+
 def test_graceful_stop_resume_matches_uninterrupted_run(tmp_path):
     """The tentpole invariant, in-process: stop at a step boundary, resume
     via the data cursor, and the remaining eval-loss trajectory is
