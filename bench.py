@@ -45,40 +45,36 @@ RECORDED = {
 # dispatch time — only a literal device_get round-trips to the chip, so
 # all timing syncs use float()/device_get.
 
-# Per-chip peak dense bf16 matmul TFLOP/s and HBM GB/s by device kind
-# (public specs). Derived from the detected device instead of hard-coding
-# v5e (round-4 ADVICE low #4) so mfu/roofline stay honest on other
-# generations. MFU below is MODEL-flops utilization: 6*N_matmul per token
-# for full training, 4*N_matmul for LoRA (no dW for frozen weights; dx
-# still flows), plus causal attention matmul flops; remat recompute is NOT
-# counted (standard MFU convention), so remat configs understate hardware
-# efficiency.
-_DEVICE_SPECS = {
-    # device_kind substring: (peak bf16 FLOP/s, HBM bytes/s)
-    "v5 lite": (197e12, 819e9),      # v5e
-    "v5e": (197e12, 819e9),
-    "v5p": (459e12, 2765e9),
-    "v4": (275e12, 1228e9),
-    "v6 lite": (918e12, 1640e9),     # Trillium
-    "v6e": (918e12, 1640e9),
-    # bare "v5" LAST: jax reports v5p as plain "TPU v5" — the v5e kind
-    # ("TPU v5 lite") must match its own entry first
-    "v5": (459e12, 2765e9),
-}
+# Per-chip peak FLOPs + HBM bandwidth come from the ONE device-spec table
+# in obs/mfu.py (deduplicated this round — bench kept a private copy that
+# had already drifted from the trainer's). MFU below is MODEL-flops
+# utilization: 6*N_matmul per token for full training, 4*N_matmul for LoRA
+# (no dW for frozen weights; dx still flows), plus causal attention matmul
+# flops; remat recompute is NOT counted (standard MFU convention), so remat
+# configs understate hardware efficiency.
 
 
 def _device_specs():
-    kind = jax.devices()[0].device_kind.lower()
-    for key, spec in _DEVICE_SPECS.items():   # ordered: "v5 lite" before "v5"
-        if key in kind:
-            return spec
+    from building_llm_from_scratch_tpu.obs import mfu as _mfu
+
+    spec = _mfu.device_specs()
+    if spec is not None:
+        return spec
     # unknown device kind: fall back to v5e numbers so ratios stay
     # comparable with BASELINE.md history — but say so when it's a real
     # TPU, because the reported MFU/roofline would be silently wrong
     if jax.default_backend() == "tpu":
+        kind = jax.devices()[0].device_kind.lower()
         print(json.dumps({"warning": f"unknown TPU device kind '{kind}'; "
                           "MFU/roofline use v5e peak numbers"}), flush=True)
-    return _DEVICE_SPECS["v5 lite"]
+    return dict(_mfu.DEVICE_SPECS)["v5e"]
+
+
+# HLO-measured efficiency of the last _pretrain_tps step (obs/compile.py
+# AOT capture): cost-analysis FLOPs/step, compile seconds, FLOPs/token.
+# Reset per run() so BENCH_*.json lines carry an efficiency trajectory,
+# not just tok/s.
+LAST_HLO = {}
 
 
 def _model_flops_per_token(cfg, lora: bool = False) -> float:
@@ -156,6 +152,25 @@ def _pretrain_tps(cfg, batch_size, policy=None, warmup=3, iters=20,
         batch = plan.shard_batch(batch)
     step = make_train_step(cfg, opt, policy=policy, lora_rank=lora_rank,
                            lora_alpha=lora_alpha, grad_accum=grad_accum)
+    # AOT-compile the step (obs/compile.py) so the line carries XLA's own
+    # cost accounting next to the measured tok/s; the compiled executable
+    # is what gets timed (one compile either way)
+    global LAST_HLO
+    try:
+        from building_llm_from_scratch_tpu.obs.compile import aot_compile
+
+        compiled, stats = aot_compile(step, state, batch)
+        if stats.get("flops"):
+            LAST_HLO = {
+                "hlo_flops_per_step": stats["flops"],
+                "hlo_flops_per_token": stats["flops"] / (
+                    batch_size * cfg.context_length),
+                "compile_seconds": stats["compile_seconds"],
+            }
+        step = compiled
+    except Exception as e:
+        print(json.dumps({"warning": f"AOT capture failed ({e}); "
+                          "timing the implicit-jit path"}), flush=True)
     dt = _time_steps(step, state, batch, warmup, iters)
     return batch_size * cfg.context_length * iters / dt / jax.device_count()
 
@@ -377,6 +392,8 @@ BENCHES = {
 
 
 def run(name: str):
+    global LAST_HLO
+    LAST_HLO = {}
     out = BENCHES[name]()
     metric, tps = out[0], out[1]
     mfu = out[2] if len(out) > 2 else None
@@ -389,6 +406,17 @@ def run(name: str):
     }
     if mfu is not None:
         line["mfu"] = round(mfu, 3)
+    if LAST_HLO.get("hlo_flops_per_token"):
+        from building_llm_from_scratch_tpu.obs.mfu import mfu_from_flops
+
+        line["hlo_flops_per_step"] = LAST_HLO["hlo_flops_per_step"]
+        line["compile_seconds"] = round(LAST_HLO["compile_seconds"], 2)
+        # per-chip tps against the same fallback peak _mfu uses, but with
+        # XLA's counted FLOPs — the delta vs "mfu" is formula drift
+        mfu_hlo = mfu_from_flops(tps, LAST_HLO["hlo_flops_per_token"],
+                                 n_devices=1, peak=_device_specs()[0])
+        if mfu_hlo is not None:
+            line["mfu_hlo"] = round(mfu_hlo, 3)
     print(json.dumps(line), flush=True)
 
 

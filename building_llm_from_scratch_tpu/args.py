@@ -16,7 +16,8 @@ re-targeted at TPU hardware:
     --keep_ckpts, --watchdog/--loss_spike_factor/--watchdog_window;
   - observability (obs/): --metrics_jsonl structured-telemetry sink,
     --log_every metrics cadence decoupled from eval, --stall_timeout
-    per-host hung-step flight recorder.
+    per-host hung-step flight recorder, --compile_cache_dir persistent
+    XLA compilation cache (with hit/miss telemetry).
 """
 
 from __future__ import annotations
@@ -267,6 +268,12 @@ def get_args(argv=None):
                              "lines, decoupled from the (expensive) eval "
                              "loop. 0 (default) logs at --eval_freq "
                              "cadence, the historical behavior.")
+    parser.add_argument("--compile_cache_dir", type=str, default=None,
+                        help="Enable JAX's persistent compilation cache at "
+                             "this directory: relaunches (the preemption-"
+                             "resume loop) skip XLA compiles. The compile "
+                             "telemetry event records cache hit/miss and "
+                             "entry counts.")
     parser.add_argument("--stall_timeout", type=float, default=0.0,
                         help="Opt-in per-host stall detector: if no train "
                              "step completes within this many seconds (or "

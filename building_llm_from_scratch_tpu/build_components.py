@@ -174,10 +174,14 @@ def build_components(args) -> Components:
         logger.info("Estimated training memory (4N Adam rule): %.2f GB",
                     estimate_memory_static(n_params, cfg.dtype))
     from building_llm_from_scratch_tpu.obs.metrics import emit_event
+    from building_llm_from_scratch_tpu.obs.mfu import flops_per_token
 
     emit_event("components_built", model=cfg.name, n_params=n_params,
                est_train_mem_gb=round(
                    estimate_memory_static(n_params, cfg.dtype), 3),
+               # analytic train FLOPs/token for this config: the baseline
+               # the compile event's HLO-counted figure is compared against
+               flops_per_token_analytic=flops_per_token(cfg),
                shard_mode=getattr(args, "shard_mode", None),
                load_weights=bool(args.load_weights))
 

@@ -22,9 +22,12 @@ TPU-first differences from the reference:
   - ``--profile`` captures a jax.profiler trace of the first steps — with
     named spans and per-step annotations since the obs/ round;
   - observability (obs/): ``--metrics_jsonl`` structured telemetry
-    (header + metrics + events; scripts/summarize_metrics.py renders it),
-    ``--log_every`` throughput/MFU/memory cadence decoupled from eval,
-    ``--stall_timeout`` per-host hung-step flight recorder.
+    (header + metrics + health + events; scripts/summarize_metrics.py
+    renders it), ``--log_every`` throughput/MFU/memory cadence decoupled
+    from eval, ``--stall_timeout`` per-host hung-step flight recorder,
+    per-layer-group training health + AOT compile/recompile telemetry
+    (obs/health.py, obs/compile.py), ``--compile_cache_dir`` persistent
+    XLA compilation cache.
 
 Usage:  python -m building_llm_from_scratch_tpu --data_dir ... [flags]
 """
@@ -84,6 +87,13 @@ def main(args) -> Trainer:
     #    until the run-metadata header lands below. Then components
     #    (reference main.py:63).
     metric_logger = configure_metrics(args.metrics_jsonl)
+    if args.compile_cache_dir:
+        # BEFORE any compile (the component build device_puts and the
+        # first train step both lower programs): a relaunched preempted
+        # job skips its multi-minute XLA compiles entirely
+        from building_llm_from_scratch_tpu.obs import enable_persistent_cache
+
+        enable_persistent_cache(args.compile_cache_dir)
     comps = build_components(args)
     cfg = comps.cfg
     metric_logger.write_header(
@@ -169,6 +179,7 @@ def main(args) -> Trainer:
         stopper=stopper,
         log_every=args.log_every,
         stall=stall,
+        compile_cache_dir=args.compile_cache_dir,
     )
 
     # 7. train / finetune (reference main.py:150-157) under the graceful-

@@ -1,5 +1,6 @@
 """Observability subsystem: structured metrics (JSONL), step timeline +
-trace annotations, MFU accounting, and the per-host stall detector.
+trace annotations, MFU accounting, per-layer-group training health, XLA
+compile telemetry, and the per-host stall detector.
 
 Entry points:
   - ``MetricLogger`` / ``configure_metrics`` / ``get_metrics`` /
@@ -7,11 +8,30 @@ Entry points:
     (obs/metrics.py);
   - ``StepTimeline`` / ``annotate`` / ``window_stats`` — per-step
     wall-clock breakdown + jax.profiler trace annotation (obs/timeline.py);
-  - ``flops_per_token`` / ``compute_mfu`` / ``format_mfu`` — analytic
-    FLOPs and MFU against chip peak (obs/mfu.py);
+  - ``flops_per_token`` / ``compute_mfu`` / ``mfu_from_flops`` /
+    ``format_mfu`` / ``device_specs`` — analytic FLOPs and MFU against
+    chip peak, one device-spec table (obs/mfu.py);
+  - ``group_health`` / ``group_names`` / ``describe_health`` — in-graph
+    per-layer-group gradient/param/update norms + non-finite localization
+    (obs/health.py);
+  - ``CompileWatcher`` / ``aot_compile`` / ``enable_persistent_cache`` —
+    AOT compile capture, HLO cost/memory analysis, recompile detection,
+    persistent-cache wiring (obs/compile.py);
   - ``StallDetector`` — opt-in hung-step flight recorder (obs/stall.py).
 """
 
+from building_llm_from_scratch_tpu.obs.compile import (
+    CompileWatcher,
+    aot_compile,
+    enable_persistent_cache,
+)
+from building_llm_from_scratch_tpu.obs.health import (
+    describe_health,
+    first_nonfinite_group,
+    group_health,
+    group_names,
+    health_summary_line,
+)
 from building_llm_from_scratch_tpu.obs.metrics import (
     MetricLogger,
     configure_metrics,
@@ -22,8 +42,10 @@ from building_llm_from_scratch_tpu.obs.metrics import (
 from building_llm_from_scratch_tpu.obs.mfu import (
     compute_mfu,
     device_peak_flops,
+    device_specs,
     flops_per_token,
     format_mfu,
+    mfu_from_flops,
 )
 from building_llm_from_scratch_tpu.obs.stall import StallDetector
 from building_llm_from_scratch_tpu.obs.timeline import (
@@ -41,8 +63,18 @@ __all__ = [
     "run_metadata",
     "compute_mfu",
     "device_peak_flops",
+    "device_specs",
     "flops_per_token",
     "format_mfu",
+    "mfu_from_flops",
+    "CompileWatcher",
+    "aot_compile",
+    "enable_persistent_cache",
+    "describe_health",
+    "first_nonfinite_group",
+    "group_health",
+    "group_names",
+    "health_summary_line",
     "StallDetector",
     "NON_STEP_SEGMENTS",
     "StepTimeline",

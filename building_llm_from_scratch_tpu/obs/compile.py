@@ -1,0 +1,410 @@
+"""XLA compile telemetry: AOT compile capture, HLO cost/memory analysis,
+recompile detection, and persistent-compilation-cache wiring.
+
+The analytic MFU in obs/mfu.py trusts a hand-derived FLOPs formula; XLA
+knows what it actually built. ``CompileWatcher`` wraps the trainer's jitted
+train step and, on the first call for each argument signature, runs the
+explicit AOT path (``lower()`` -> ``compile()``) so compile time becomes a
+measured number instead of an invisible chunk of the first step, then reads
+the executable's ``cost_analysis()`` (HLO-counted FLOPs -> an HLO-measured
+MFU to cross-check the analytic one) and ``memory_analysis()`` (HBM
+breakdown: arguments / outputs / temps / generated code vs device
+capacity — the OOM postmortem numbers). Each capture lands as one
+``compile`` event in the metrics JSONL plus gauges.
+
+A signature change after the first call is a RECOMPILE — the classic silent
+TPU performance bug (a ragged last batch, a dtype drift after resume): the
+watcher emits a ``recompile`` event naming the exact leaf-path shape/dtype
+diff, then captures the new executable the same way. Steady-state calls are
+a dict lookup + the dispatch itself.
+
+``--compile_cache_dir`` enables JAX's persistent compilation cache with
+entry-count/bytes telemetry: the compile event records whether this
+process's compile was served from cache (no new entries written) or paid
+for (new entries landed), so relaunch latency is measurable.
+
+Failure policy: telemetry must never take down the run it observes. If the
+AOT path raises for an exotic step builder, the watcher logs, emits a
+``compile_fallback`` event, and permanently delegates to the wrapped jit
+function (whose implicit compile still happens, just unmeasured).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from building_llm_from_scratch_tpu.obs.metrics import get_metrics
+from building_llm_from_scratch_tpu.utils.logging import setup_logger
+
+logger = setup_logger(__name__)
+
+#: memory_analysis() attributes surfaced in the compile event (bytes).
+_MEMORY_FIELDS = (
+    ("argument_size_in_bytes", "args_bytes"),
+    ("output_size_in_bytes", "output_bytes"),
+    ("temp_size_in_bytes", "temp_bytes"),
+    ("alias_size_in_bytes", "alias_bytes"),
+    ("generated_code_size_in_bytes", "generated_code_bytes"),
+)
+
+
+def fast_signature(tree: Any) -> Tuple:
+    """Steady-state cache key for the watcher's per-step check: (treedef,
+    per-leaf (shape, dtype, sharding)). Unlike ``tree_signature`` it builds
+    NO path strings — shape tuples, dtype objects and shardings are
+    existing hashables, so the hot loop pays one tree_flatten and a tuple
+    build, keeping the no-per-step-host-work discipline. The treedef
+    covers structural changes that path strings would have caught."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    return treedef, tuple(
+        (getattr(leaf, "shape", ()), getattr(leaf, "dtype", None),
+         getattr(leaf, "sharding", None))
+        for leaf in leaves)
+
+
+def tree_signature(tree: Any) -> Tuple:
+    """Hashable (path, shape, dtype, sharding) signature of a pytree of
+    arrays — what XLA keys its compiled executables on. Shardings are part
+    of the key because an AOT executable is pinned to them: under fsdp the
+    optimizer-state shardings legitimately change between the first and
+    second step (shard_state places them replicated, the step's
+    with_sharding_constraint pins them sharded), which plain jit silently
+    re-compiled for — the watcher must key on it too (and now reports it).
+    Cheap host work: attribute reads only, no device sync."""
+    flat, treedef = jax_tree_flatten_with_path(tree)
+    return tuple(
+        (path, tuple(getattr(leaf, "shape", ())),
+         str(getattr(leaf, "dtype", type(leaf).__name__)),
+         getattr(leaf, "sharding", None))
+        for path, leaf in flat)
+
+
+def jax_tree_flatten_with_path(tree: Any) -> Tuple[List[Tuple[str, Any]], Any]:
+    """tree_flatten_with_path with the path rendered as a compact string
+    ('trainable/blocks/attn/wq') so signature diffs read as leaf names."""
+    import jax
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    out = []
+    for path, leaf in flat:
+        parts = []
+        for p in path:
+            key = getattr(p, "key", None)
+            if key is None:
+                key = getattr(p, "idx", None)
+            parts.append(str(key))
+        out.append(("/".join(parts), leaf))
+    return out, treedef
+
+
+def _leaf_desc(sig_entry) -> Dict[str, Any]:
+    shape, dtype = sig_entry[0], sig_entry[1]
+    out: Dict[str, Any] = {"shape": list(shape), "dtype": dtype}
+    sharding = sig_entry[2] if len(sig_entry) > 2 else None
+    if sharding is not None:
+        spec = getattr(sharding, "spec", None)
+        out["sharding"] = str(spec if spec is not None else sharding)
+    return out
+
+
+def signature_diff(old: Tuple, new: Tuple) -> List[Dict[str, Any]]:
+    """Human-readable leaf-level diff between two tree signatures: changed
+    shapes/dtypes/shardings plus added/removed leaves."""
+    old_map = {e[0]: e[1:] for e in old}
+    new_map = {e[0]: e[1:] for e in new}
+    diff: List[Dict[str, Any]] = []
+    for path in sorted(set(old_map) | set(new_map)):
+        a, b = old_map.get(path), new_map.get(path)
+        if a == b:
+            continue
+        entry: Dict[str, Any] = {"leaf": path}
+        if a is None:
+            entry["added"] = _leaf_desc(b)
+        elif b is None:
+            entry["removed"] = _leaf_desc(a)
+        else:
+            entry["was"] = _leaf_desc(a)
+            entry["now"] = _leaf_desc(b)
+        diff.append(entry)
+    return diff
+
+
+def extract_cost_analysis(compiled) -> Dict[str, float]:
+    """Normalize ``Compiled.cost_analysis()`` across jax versions (dict in
+    newer releases, [dict] per-device in 0.4.x) to flat float fields."""
+    try:
+        cost = compiled.cost_analysis()
+    except Exception as e:                     # pragma: no cover - backend gap
+        logger.warning("cost_analysis unavailable: %s", e)
+        return {}
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    if not isinstance(cost, dict):
+        return {}
+    out: Dict[str, float] = {}
+    for key in ("flops", "transcendentals", "bytes accessed"):
+        val = cost.get(key)
+        if isinstance(val, (int, float)):
+            out[key.replace(" ", "_")] = float(val)
+    return out
+
+
+def extract_memory_analysis(compiled) -> Dict[str, int]:
+    """``Compiled.memory_analysis()`` -> {args/output/temp/alias/
+    generated_code}_bytes (+ total), or {} when the backend exposes none."""
+    try:
+        mem = compiled.memory_analysis()
+    except Exception as e:                     # pragma: no cover - backend gap
+        logger.warning("memory_analysis unavailable: %s", e)
+        return {}
+    if mem is None:
+        return {}
+    out: Dict[str, int] = {}
+    for attr, name in _MEMORY_FIELDS:
+        val = getattr(mem, attr, None)
+        if isinstance(val, int):
+            out[name] = val
+    if out:
+        # peak-footprint proxy: aliased bytes (donated inputs) are reused
+        # by outputs, so counting args+outputs+temps double-counts them
+        out["total_bytes"] = (out.get("args_bytes", 0)
+                              + out.get("output_bytes", 0)
+                              + out.get("temp_bytes", 0)
+                              + out.get("generated_code_bytes", 0)
+                              - out.get("alias_bytes", 0))
+    return out
+
+
+def device_hbm_capacity() -> Optional[int]:
+    """bytes_limit of device 0, or None off-TPU (CPU memory_stats is None)."""
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    limit = stats.get("bytes_limit")
+    return int(limit) if isinstance(limit, int) else None
+
+
+def executable_device_count(compiled) -> int:
+    """Number of devices the compiled executable spans, read off its input
+    shardings (1 for a plain single-device jit). Needed to globalize
+    ``cost_analysis()``: under SPMD it reports the PER-DEVICE module's
+    numbers."""
+    try:
+        import jax
+
+        best = 1
+        for s in jax.tree_util.tree_leaves(compiled.input_shardings):
+            device_set = getattr(s, "device_set", None)
+            if device_set:
+                best = max(best, len(device_set))
+        return best
+    except Exception:
+        return 1
+
+
+def aot_compile(fn: Callable, *args) -> Tuple[Any, Dict[str, Any]]:
+    """Explicitly lower+compile a jitted callable for ``args``; returns
+    (compiled_executable, stats). Stats carry ``compile_seconds`` split
+    into lower/backend-compile, cost analysis and the memory breakdown.
+
+    Cost numbers are GLOBAL: ``cost_analysis()`` reports the per-device
+    SPMD module (measured: a 2-device-sharded matmul reports half the
+    single-device FLOPs), so ``flops``/``transcendentals``/
+    ``bytes_accessed`` are scaled by the executable's device count —
+    consumers divide by global token counts. The per-device figure stays
+    as ``flops_per_device``; the ``memory`` breakdown is deliberately
+    per-device (it is compared against one device's HBM capacity).
+
+    Raises whatever the trace/compile raises — callers own fallback."""
+    t0 = time.perf_counter()
+    lowered = fn.lower(*args)
+    t1 = time.perf_counter()
+    compiled = lowered.compile()
+    t2 = time.perf_counter()
+    stats: Dict[str, Any] = {
+        "compile_seconds": round(t2 - t0, 4),
+        "lower_seconds": round(t1 - t0, 4),
+        "backend_compile_seconds": round(t2 - t1, 4),
+    }
+    cost = extract_cost_analysis(compiled)
+    n_dev = executable_device_count(compiled)
+    stats["executable_device_count"] = n_dev
+    if n_dev > 1 and "flops" in cost:
+        cost["flops_per_device"] = cost["flops"]
+        for key in ("flops", "transcendentals", "bytes_accessed"):
+            if key in cost:
+                cost[key] = cost[key] * n_dev
+    stats.update(cost)
+    mem = extract_memory_analysis(compiled)
+    if mem:
+        stats["memory"] = mem
+    return compiled, stats
+
+
+class CompileWatcher:
+    """Wraps a jitted train step: AOT-compiles per argument signature,
+    emits ``compile``/``recompile`` telemetry, and exposes the HLO-measured
+    FLOPs for the trainer's MFU cross-check.
+
+    Call-compatible with the wrapped step: ``watcher(state, batch)``.
+    """
+
+    def __init__(self, fn: Callable, label: str = "train_step",
+                 cache_dir: Optional[str] = None):
+        self._fn = fn
+        self.label = label
+        self.cache_dir = cache_dir
+        self._compiled: Dict[Tuple, Callable] = {}
+        self._last_sig: Optional[Tuple] = None
+        self._disabled = False
+        self.n_compiles = 0
+        self.n_recompiles = 0
+        self.compile_seconds_total = 0.0
+        #: HLO-counted FLOPs for ONE step at the latest signature (None
+        #: until the first capture, or when cost_analysis has no flops).
+        self.hlo_flops_per_step: Optional[float] = None
+        #: ... divided by the batch's token count (set when the batch
+        #: carries an "inputs" leaf), for the HLO-measured MFU.
+        self.hlo_flops_per_token: Optional[float] = None
+        self.memory: Dict[str, int] = {}
+
+    # -- internals -------------------------------------------------------
+
+    def _cache_entries(self) -> Optional[int]:
+        if not self.cache_dir or not os.path.isdir(self.cache_dir):
+            return None
+        try:
+            return sum(1 for n in os.listdir(self.cache_dir)
+                       if n.endswith("-cache"))
+        except OSError:
+            return None
+
+    def _capture(self, sig: Tuple, state, batch) -> Callable:
+        entries_before = self._cache_entries()
+        compiled, stats = aot_compile(self._fn, state, batch)
+        entries_after = self._cache_entries()
+        self.n_compiles += 1
+        self.compile_seconds_total += stats["compile_seconds"]
+        self.hlo_flops_per_step = stats.get("flops")
+        self.memory = stats.get("memory", {})
+        n_tokens = None
+        try:
+            n_tokens = int(batch["inputs"].size)
+        except (TypeError, KeyError, AttributeError):
+            pass
+        if n_tokens and self.hlo_flops_per_step:
+            self.hlo_flops_per_token = self.hlo_flops_per_step / n_tokens
+        event = dict(stats, label=self.label, n_compiles=self.n_compiles)
+        if n_tokens:
+            event["tokens_per_step"] = n_tokens
+        capacity = device_hbm_capacity()
+        if capacity and self.memory:
+            event["hbm_capacity_bytes"] = capacity
+            event["hbm_budget_frac"] = round(
+                self.memory.get("total_bytes", 0) / capacity, 4)
+        if entries_before is not None and entries_after is not None:
+            event["cache_dir"] = self.cache_dir
+            event["cache_entries"] = entries_after
+            # a served-from-cache compile writes no new entries; count
+            # deltas instead of guessing from timing
+            event["cache_hit"] = (entries_after == entries_before
+                                  and entries_before > 0)
+        sink = get_metrics()
+        sink.event("compile", **event)
+        sink.gauge("compile_seconds_total",
+                   round(self.compile_seconds_total, 4))
+        for name, val in self.memory.items():
+            sink.gauge(f"hlo_{name}", val)
+        logger.info(
+            "%s compiled in %.2fs (HLO %s flops/step%s)", self.label,
+            stats["compile_seconds"],
+            f"{self.hlo_flops_per_step:.3g}" if self.hlo_flops_per_step
+            else "n/a",
+            f", temps {self.memory['temp_bytes'] / 1024**2:.0f} MiB"
+            if "temp_bytes" in self.memory else "")
+        return compiled
+
+    # -- the step --------------------------------------------------------
+
+    @property
+    def __name__(self) -> str:
+        # call-compatible includes introspection: tests (and tqdm-style
+        # tooling) read the step function's name
+        return getattr(self._fn, "__name__", self.label)
+
+    def __call__(self, state, batch):
+        if self._disabled:
+            return self._fn(state, batch)
+        key = (fast_signature(state), fast_signature(batch))
+        fn = self._compiled.get(key)
+        if fn is None:
+            # only a miss pays for the human-readable path-string
+            # signature (the diff needs leaf names); steady-state steps
+            # never build strings
+            sig = (tree_signature(state), tree_signature(batch))
+            if self._last_sig is not None:
+                self.n_recompiles += 1
+                diff = [d for pair in zip(self._last_sig, sig)
+                        for d in signature_diff(*pair)]
+                sink = get_metrics()
+                # a tree-wide drift (fsdp opt-state resharding, resume
+                # dtype change) diffs every leaf — cap the serialized row
+                sink.event("recompile", label=self.label,
+                           n_recompiles=self.n_recompiles,
+                           n_changed_leaves=len(diff), diff=diff[:50])
+                sink.gauge("recompile_count", self.n_recompiles)
+                leaves = [d["leaf"] for d in diff]
+                shown = "; ".join(leaves[:6]) + (
+                    f"; … +{len(leaves) - 6} more" if len(leaves) > 6 else "")
+                logger.warning(
+                    "%s RECOMPILE #%d: argument signature changed (%s)",
+                    self.label, self.n_recompiles, shown or "unknown leaf")
+            try:
+                fn = self._capture(sig, state, batch)
+            except Exception as e:
+                # telemetry must not kill the run: fall back to the plain
+                # jit path (which will surface REAL trace errors itself)
+                logger.warning(
+                    "AOT compile capture failed for %s (%s: %s); compile "
+                    "telemetry disabled for this step.", self.label,
+                    type(e).__name__, e)
+                get_metrics().event("compile_fallback", label=self.label,
+                                    error=f"{type(e).__name__}: {e}")
+                self._disabled = True
+                return self._fn(state, batch)
+            self._compiled[key] = fn
+            self._last_sig = sig
+        return fn(state, batch)
+
+
+def enable_persistent_cache(cache_dir: str) -> None:
+    """Wire JAX's persistent compilation cache at ``cache_dir``
+    (--compile_cache_dir): relaunches — the preemption-resume loop — skip
+    the multi-minute XLA compile entirely. Thresholds are zeroed so every
+    executable is eligible (default jax skips sub-second compiles, which
+    would make smoke-test telemetry read as permanent misses)."""
+    import jax
+    from jax.experimental.compilation_cache import compilation_cache
+
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    try:
+        # any compile BEFORE the dir is set (set_seed's PRNG key, a device
+        # put) initializes the cache machinery in its disabled state, and
+        # set_cache_dir alone cannot revive it — reset first (measured on
+        # jax 0.4.37: without this the dir stays empty forever)
+        compilation_cache.reset_cache()
+    except Exception:
+        pass
+    compilation_cache.set_cache_dir(cache_dir)
+    logger.info("Persistent compilation cache at %s", cache_dir)

@@ -16,9 +16,14 @@ JSONL schema (one JSON object per line, ``type`` discriminates):
   - ``metrics`` — per-cadence numbers: ``step`` plus free-form scalar
     fields (loss/lr/tok_s/mfu/step_time_s/memory gauges/...). ``step`` is
     monotonically increasing across rows.
+  - ``health``  — per-cadence PER-LAYER-GROUP training-health arrays
+    (obs/health.py): ``step``, ``groups`` (ordered names) and parallel
+    ``grad_norm``/``param_norm``/``update_norm``/``update_ratio`` lists,
+    plus ``first_nonfinite`` (group name or null). Separate from
+    ``metrics`` so scalar-row consumers never see list-valued fields.
   - ``event``   — typed structured events (``event`` names the kind:
     checkpoint_save, checkpoint_fallback, preemption_stop, watchdog_halt,
-    retry, stall, ...), with free-form fields.
+    compile, recompile, retry, stall, ...), with free-form fields.
 
 One run = one file: if the path already holds a previous run's telemetry
 (a ``--resume auto`` relaunch reuses the same command), the old file is
@@ -49,7 +54,7 @@ from building_llm_from_scratch_tpu.utils.logging import setup_logger
 
 logger = setup_logger(__name__)
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2          # v2: + "health" row type, compile/recompile events
 
 
 def _is_coordinator() -> bool:
@@ -79,6 +84,15 @@ def _jsonable(value: Any) -> Any:
         return {str(k): _jsonable(v) for k, v in value.items()}
     if isinstance(value, (list, tuple)):
         return [_jsonable(v) for v in value]
+    if getattr(value, "ndim", None):
+        # numpy / jax arrays (health bundles): element-wise via tolist so
+        # NaN/Inf entries still get the finite-only treatment above
+        tolist = getattr(value, "tolist", None)
+        if callable(tolist):
+            try:
+                return _jsonable(tolist())
+            except Exception:
+                pass
     item = getattr(value, "item", None)
     if callable(item):
         try:
@@ -207,6 +221,15 @@ class MetricLogger:
             logger.warning("Metrics row step went backwards (%d < %d)",
                            step, self._last_step)
         self._last_step = max(self._last_step, int(step))
+        self._write_row(row)
+
+    def log_health(self, step: int, groups, **arrays: Any) -> None:
+        """One ``health`` row: ordered group names + parallel per-group
+        arrays (obs/health.py bundle). List-valued by design — kept out of
+        the scalar ``metrics`` rows so existing consumers stay flat."""
+        row = {"type": "health", "time": time.time(), "step": int(step),
+               "groups": list(groups)}
+        row.update(arrays)
         self._write_row(row)
 
     def event(self, kind: str, step: Optional[int] = None,

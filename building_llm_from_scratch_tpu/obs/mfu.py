@@ -28,20 +28,28 @@ from typing import Optional
 
 from building_llm_from_scratch_tpu.configs import ModelConfig
 
-#: bf16 dense peak FLOPs per CHIP, by device_kind substring (lowercased).
-#: Order matters: first match wins, so longer/more specific keys go first.
-TPU_PEAK_FLOPS = (
-    ("v6e", 918e12),         # Trillium
-    ("v6", 918e12),
-    ("v5p", 459e12),
-    ("v5e", 197e12),
-    ("v5 lite", 197e12),     # jax reports v5e as "TPU v5 lite"
-    ("v5litepod", 197e12),
-    ("v5", 459e12),
-    ("v4", 275e12),
-    ("v3", 123e12),
-    ("v2", 45e12),
+#: Per-chip public specs by device_kind substring (lowercased):
+#: (peak bf16 dense FLOPs/s, HBM bytes/s). The ONE table — bench.py's
+#: roofline math and the trainer's MFU both read it, so a new TPU
+#: generation is one line here, not a hunt for private copies.
+#: Order matters: first match wins, so longer/more specific keys go first
+#: (jax reports v5e as "TPU v5 lite" and v5p as plain "TPU v5").
+DEVICE_SPECS = (
+    ("v6e", (918e12, 1640e9)),        # Trillium
+    ("v6 lite", (918e12, 1640e9)),
+    ("v6", (918e12, 1640e9)),
+    ("v5p", (459e12, 2765e9)),
+    ("v5e", (197e12, 819e9)),
+    ("v5 lite", (197e12, 819e9)),
+    ("v5litepod", (197e12, 819e9)),
+    ("v5", (459e12, 2765e9)),
+    ("v4", (275e12, 1228e9)),
+    ("v3", (123e12, 900e9)),
+    ("v2", (45e12, 700e9)),
 )
+
+#: Back-compat view: (key, peak FLOPs) pairs.
+TPU_PEAK_FLOPS = tuple((k, spec[0]) for k, spec in DEVICE_SPECS)
 
 
 def flops_per_token(cfg: ModelConfig, seq_len: Optional[int] = None) -> int:
@@ -52,9 +60,10 @@ def flops_per_token(cfg: ModelConfig, seq_len: Optional[int] = None) -> int:
     return 6 * n_matmul + attention
 
 
-def device_peak_flops(device=None) -> Optional[float]:
-    """Peak bf16 FLOPs for one chip, or None when unknown (CPU/GPU test
-    backends). Never initializes a backend the caller hasn't."""
+def device_specs(device=None) -> Optional[tuple]:
+    """(peak bf16 FLOPs, HBM bytes/s) for one chip, or None when unknown
+    (CPU/GPU test backends). Never initializes a backend the caller
+    hasn't."""
     if device is None:
         try:
             import jax
@@ -65,10 +74,34 @@ def device_peak_flops(device=None) -> Optional[float]:
     kind = str(getattr(device, "device_kind", "")).lower()
     if "tpu" not in kind and not kind.startswith("v"):
         return None
-    for key, peak in TPU_PEAK_FLOPS:
+    for key, spec in DEVICE_SPECS:
         if key in kind:
-            return peak
+            return spec
     return None
+
+
+def device_peak_flops(device=None) -> Optional[float]:
+    """Peak bf16 FLOPs for one chip, or None when unknown."""
+    spec = device_specs(device)
+    return spec[0] if spec is not None else None
+
+
+def mfu_from_flops(tokens_per_s: float, flops_per_token: float,
+                   n_devices: Optional[int] = None,
+                   peak: Optional[float] = None) -> Optional[float]:
+    """MFU for an arbitrary FLOPs/token figure — the shared denominator
+    math for the analytic estimate AND the HLO-measured cross-check
+    (obs/compile.py's ``cost_analysis`` FLOPs). None when the chip peak
+    is unknown or inputs are degenerate."""
+    if peak is None:
+        peak = device_peak_flops()
+    if peak is None or tokens_per_s <= 0 or not flops_per_token:
+        return None
+    if n_devices is None:
+        import jax
+
+        n_devices = jax.local_device_count()
+    return tokens_per_s * flops_per_token / (peak * max(1, n_devices))
 
 
 def compute_mfu(tokens_per_s: float, cfg: ModelConfig,
@@ -83,16 +116,8 @@ def compute_mfu(tokens_per_s: float, cfg: ModelConfig,
     ``jax.local_device_count()``, which equals the global ratio on
     symmetric pods.
     """
-    if peak is None:
-        peak = device_peak_flops()
-    if peak is None or tokens_per_s <= 0:
-        return None
-    if n_devices is None:
-        import jax
-
-        n_devices = jax.local_device_count()
-    achieved = tokens_per_s * flops_per_token(cfg, seq_len)
-    return achieved / (peak * max(1, n_devices))
+    return mfu_from_flops(tokens_per_s, flops_per_token(cfg, seq_len),
+                          n_devices=n_devices, peak=peak)
 
 
 def format_mfu(mfu: Optional[float]) -> str:

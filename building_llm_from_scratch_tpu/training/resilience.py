@@ -348,7 +348,8 @@ class LossWatchdog:
     """
 
     def __init__(self, spike_factor: float = 10.0, window: int = 50,
-                 min_history: int = 20, check_finite: bool = True):
+                 min_history: int = 20, check_finite: bool = True,
+                 context_fn=None):
         if spike_factor <= 1.0:
             raise ValueError(
                 f"spike_factor must be > 1, got {spike_factor}")
@@ -359,31 +360,72 @@ class LossWatchdog:
         self.min_history = min(min_history, window)
         self.check_finite = check_finite
         self._history: deque = deque(maxlen=window)
+        # optional context provider (the trainer wires its per-layer-group
+        # health digest, obs/health.py): extra fields attached to the
+        # watchdog_halt event + diagnostic so the halt names the offending
+        # LAYER, not just "diverged somewhere"
+        self.context_fn = context_fn
+
+    def _context(self) -> dict:
+        if self.context_fn is None:
+            return {}
+        try:
+            return dict(self.context_fn() or {})
+        except Exception as e:   # context is best-effort: never mask the halt
+            logger.warning("Watchdog context provider failed: %s", e)
+            return {}
+
+    @staticmethod
+    def _context_note(ctx: dict) -> str:
+        group = ctx.get("first_nonfinite_group")
+        if group:
+            return f" First non-finite layer group: {group}."
+        top = ctx.get("top_grad_norm_groups")
+        if top:
+            head = top[0]
+            return (f" Largest gradient norm: {head.get('group')} "
+                    f"({head.get('grad_norm')}).")
+        return ""
+
+    @staticmethod
+    def _merge_fields(fields: dict, ctx: dict) -> dict:
+        """Context fields must never shadow the event's own kwargs: a
+        colliding key (a context that returns 'reason' or 'recent') would
+        raise TypeError at emit time and mask the halt diagnostic."""
+        fields.update({k: v for k, v in ctx.items()
+                       if k not in fields
+                       and k not in ("step", "event", "type", "time")})
+        return fields
 
     def observe(self, step: int, loss: float) -> None:
         if self.check_finite and not np.isfinite(loss):
-            emit_event("watchdog_halt", step=step, loss=float(loss),
-                       reason="non_finite", recent=self._tail())
+            ctx = self._context()
+            fields = self._merge_fields(
+                dict(loss=float(loss), reason="non_finite",
+                     recent=self._tail()), ctx)
+            emit_event("watchdog_halt", step=step, **fields)
             raise TrainingDivergedError(
                 f"Train loss became non-finite ({loss}) by step {step}. "
-                f"Recent losses: {self._tail()}. The model has diverged — "
-                "lower the learning rate, raise warmup, or resume from an "
-                "earlier checkpoint.")
+                f"Recent losses: {self._tail()}.{self._context_note(ctx)} "
+                "The model has diverged — lower the learning rate, raise "
+                "warmup, or resume from an earlier checkpoint.")
         if len(self._history) >= self.min_history:
             median = float(np.median(self._history))
             if np.isfinite(loss) and loss > self.spike_factor * max(
                     median, 1e-8):
-                emit_event("watchdog_halt", step=step, loss=float(loss),
-                           reason="spike", median=median,
-                           spike_factor=self.spike_factor,
-                           recent=self._tail())
+                ctx = self._context()
+                fields = self._merge_fields(
+                    dict(loss=float(loss), reason="spike", median=median,
+                         spike_factor=self.spike_factor,
+                         recent=self._tail()), ctx)
+                emit_event("watchdog_halt", step=step, **fields)
                 raise TrainingDivergedError(
                     f"Train loss {loss:.4f} at step {step} spiked above "
                     f"{self.spike_factor:g}x the running median "
                     f"{median:.4f} (window={self._history.maxlen}). Recent "
-                    f"losses: {self._tail()}. Halting instead of training "
-                    "on a diverged model; resume from an earlier checkpoint "
-                    "with a lower LR.")
+                    f"losses: {self._tail()}.{self._context_note(ctx)} "
+                    "Halting instead of training on a diverged model; "
+                    "resume from an earlier checkpoint with a lower LR.")
         self._history.append(float(loss))
 
     def _tail(self, n: int = 8) -> List[float]:

@@ -39,6 +39,7 @@ import optax
 
 from building_llm_from_scratch_tpu.configs import ModelConfig
 from building_llm_from_scratch_tpu.models.lora import merge_lora
+from building_llm_from_scratch_tpu.obs.health import group_health
 from building_llm_from_scratch_tpu.models.transformer import (
     forward,
     forward_hidden,
@@ -269,7 +270,15 @@ def _finish_step(state: Params, loss, grads, n_tokens: int,
                  optimizer, lr_schedule, policy):
     """Optimizer update + new state + metrics; with loss scaling, overflow
     steps are skipped (params/opt state kept) and the scale halved, while a
-    streak of ``scale_growth_interval`` finite steps doubles it."""
+    streak of ``scale_growth_interval`` finite steps doubles it.
+
+    Metrics carry the global pre-clip ``grad_norm`` AND the post-clip
+    ``update_norm`` (``optax.clip_by_global_norm`` sits first in the
+    optimizer chain, so a capped step is finally observable instead of
+    silent), plus the per-layer-group ``health`` bundle (obs/health.py):
+    (n_groups,) grad/param/update norms, update-to-param ratios, and
+    first-non-finite-group localization — all in-graph, fetched by the
+    trainer only at logging cadence."""
     use_scaling = "loss_scale" in state
     grad_norm = optax.global_norm(grads)
     updates, new_opt_state = optimizer.update(grads, state["opt_state"],
@@ -285,7 +294,9 @@ def _finish_step(state: Params, loss, grads, n_tokens: int,
     metrics = {
         "loss": loss,
         "grad_norm": grad_norm,
+        "update_norm": optax.global_norm(updates),
         "tokens": jnp.asarray(n_tokens, jnp.int32),
+        "health": group_health(grads, new_trainable, updates),
     }
     if use_scaling:
         scale = state["loss_scale"]

@@ -47,11 +47,19 @@ from building_llm_from_scratch_tpu.generate import (
 )
 from building_llm_from_scratch_tpu.models.lora import merge_lora
 from building_llm_from_scratch_tpu.obs import (
+    CompileWatcher,
     StepTimeline,
     compute_mfu,
+    describe_health,
     format_mfu,
     get_metrics,
+    mfu_from_flops,
     window_stats,
+)
+from building_llm_from_scratch_tpu.obs.health import (
+    group_names as health_group_names,
+    health_summary_line,
+    nonfinite_group_name,
 )
 from building_llm_from_scratch_tpu.training.checkpoint import (
     checkpoint_metadata,
@@ -113,7 +121,9 @@ class Trainer:
                  watchdog: Optional[LossWatchdog] = None,
                  stopper: Optional[GracefulStopper] = None,
                  log_every: int = 0,
-                 stall=None):
+                 stall=None,
+                 compile_cache_dir: Optional[str] = None,
+                 compile_telemetry: bool = True):
         self.cfg = cfg
         self.tokenizer = tokenizer
         self.loader = loader
@@ -148,6 +158,21 @@ class Trainer:
         # stall detector heartbeated once per step-loop iteration
         self.log_every = log_every
         self.stall = stall
+        # compile telemetry (obs/compile.py): the AOT watcher wrapping the
+        # train step (compile seconds, HLO cost/memory analysis, recompile
+        # detection); cache_dir only feeds entry-count hit/miss telemetry —
+        # enabling the persistent cache itself is main.py's job (it must
+        # happen before ANY compile, not just the train step's)
+        self.compile_cache_dir = compile_cache_dir
+        self.compile_telemetry = compile_telemetry
+        self._compile_watcher: Optional[CompileWatcher] = None
+        # per-layer-group health (obs/health.py): device arrays appended per
+        # step (async DMA posted), fetched ONLY at _flush_metrics cadence
+        self._health_names: List[str] = []
+        self._pending_health: List[Any] = []
+        self._health_by_step: Dict[int, Any] = {}
+        self._last_health = None
+        self._ctx_health = None
         self.timeline = StepTimeline()
         # (epoch, file_index, batch_index) of the NEXT batch to train —
         # written into checkpoint metadata so resume fast-forwards the
@@ -301,6 +326,7 @@ class Trainer:
                 lr_schedule=self.lr_schedule, **pp_kw)
             self.eval_step = make_pp_eval_step(self.cfg, self.plan.mesh,
                                                **pp_kw)
+            self._finalize_steps()
             return
         if (self.plan is not None and self.policy is not None
                 and self.policy.reduce_dtype != self.policy.compute_dtype
@@ -329,6 +355,31 @@ class Trainer:
                 self.cfg, self.optimizer, lr_schedule=self.lr_schedule,
                 grad_accum=self.grad_accum, **kw)
         self.eval_step = make_eval_step(self.cfg, **kw)
+        self._finalize_steps()
+
+    def _finalize_steps(self):
+        """Common post-step-builder wiring: per-layer-group health names
+        (host-side mirror of the in-graph group order), the watchdog's
+        which-layer context provider, and the AOT compile watcher around
+        the train step (compile/recompile telemetry, obs/compile.py)."""
+        self._health_names = health_group_names(self.state["trainable"])
+        if self.watchdog is not None and self.watchdog.context_fn is None:
+            self.watchdog.context_fn = self._watchdog_context
+        if self.compile_telemetry:
+            self._compile_watcher = CompileWatcher(
+                self.train_step, label="train_step",
+                cache_dir=self.compile_cache_dir)
+            self.train_step = self._compile_watcher
+
+    def _watchdog_context(self) -> Dict[str, Any]:
+        """Health digest attached to watchdog_halt events: names the first
+        non-finite layer group (or the top gradient-norm groups) for the
+        step whose loss tripped the halt."""
+        fetched = self._ctx_health if self._ctx_health is not None \
+            else self._last_health
+        if fetched is None or not self._health_names:
+            return {}
+        return describe_health(self._health_names, fetched)
 
     def _device_batch(self, arrays: Sequence[np.ndarray]) -> Dict[str, Any]:
         names = ("inputs", "targets", "weights")
@@ -523,6 +574,16 @@ class Trainer:
                 except (AttributeError, RuntimeError):
                     pass
                 self._pending_losses.append(loss)
+            health = metrics.get("health")
+            if health is not None:
+                # same deferred-fetch discipline: post the (G,)-array DMAs
+                # now, convert to host values only at flush cadence
+                for v in health.values():
+                    try:
+                        v.copy_to_host_async()
+                    except (AttributeError, RuntimeError):
+                        pass
+                self._pending_health.append((self.global_step, health))
 
             if self._profiling and self.global_step >= self._profile_stop_at:
                 jax.profiler.stop_trace()
@@ -552,6 +613,14 @@ class Trainer:
                 # the throughput window and deflated it
                 t_tokens, t_start = 0, time.perf_counter()
                 mfu = compute_mfu(tps, self.cfg)
+                # HLO-measured MFU cross-check: same throughput, but the
+                # FLOPs/token XLA counted in the compiled step instead of
+                # the analytic formula — a drifting delta means the
+                # formula (or the graph) changed
+                watcher = self._compile_watcher
+                mfu_hlo = (mfu_from_flops(tps, watcher.hlo_flops_per_token)
+                           if watcher is not None
+                           and watcher.hlo_flops_per_token else None)
                 row = {
                     "lr": self.track_lrs[-1] if self.track_lrs else None,
                     "tokens_seen": self.tokens_seen,
@@ -563,6 +632,19 @@ class Trainer:
                     "host_fetch_s": round(window.get("host_fetch", 0.0), 6),
                     "steps_in_window": int(window.get("steps", 0)),
                 }
+                if mfu_hlo is not None:
+                    row["mfu_hlo"] = mfu_hlo
+                    if mfu is not None:
+                        row["mfu_delta"] = round(mfu_hlo - mfu, 4)
+                if self._last_health is not None:
+                    # global pre-clip grad norm and post-clip update norm,
+                    # derived from the already-fetched health bundle (the
+                    # group-norms-compose identity is test-asserted) — no
+                    # extra device fetch
+                    for key in ("grad_norm", "update_norm"):
+                        row[key] = round(float(np.sqrt(np.sum(
+                            np.asarray(self._last_health[key],
+                                       np.float64) ** 2))), 8)
                 dev_mem = device_memory_stats()
                 if dev_mem:
                     row["hbm_bytes_in_use"] = dev_mem.get("bytes_in_use")
@@ -584,6 +666,9 @@ class Trainer:
                         "%.0f tok/s, %s",
                         self.global_step, train_loss, val_loss,
                         self.track_lrs[-1], tps, format_mfu(mfu))
+                    if self._last_health is not None:
+                        logger.info("%s", health_summary_line(
+                            self._health_names, self._last_health))
                 else:
                     logger.info(
                         "step %d: lr %.2e, %.0f tok/s, %s, "
@@ -593,6 +678,7 @@ class Trainer:
                         1e3 * (stats["step_time_s"] or 0.0),
                         1e3 * window.get("data_wait", 0.0))
                 self.metrics_sink.log_metrics(self.global_step, **row)
+                self._emit_health_row()
 
             if self.global_step % self.print_sample_iter == 0:
                 with self.timeline.span("sample"):
@@ -648,6 +734,16 @@ class Trainer:
             self.track_lrs.extend(
                 float(np.asarray(lr)) for lr in self._pending_lrs)
             self._pending_lrs.clear()
+        if self._pending_health:
+            pending, self._pending_health = self._pending_health, []
+            # (G,)-sized arrays whose DMAs were posted at append time: the
+            # reads here are cheap syncs, and keeping the per-step map lets
+            # the watchdog context name the layer AT THE HALT STEP, not
+            # whatever step happened to be last in the window
+            self._health_by_step = {
+                step: {k: np.asarray(v) for k, v in h.items()}
+                for step, h in pending}
+            self._last_health = self._health_by_step[pending[-1][0]]
         if self._pending_losses:
             fetched = [float(np.asarray(x)) for x in self._pending_losses]
             self._pending_losses.clear()
@@ -655,8 +751,27 @@ class Trainer:
                 # base step of the oldest pending loss, so the diagnostic
                 # names the step the divergence actually happened at
                 base = self.global_step - len(fetched)
-                for i, loss in enumerate(fetched):
-                    self.watchdog.observe(base + i + 1, loss)
+                try:
+                    for i, loss in enumerate(fetched):
+                        self._ctx_health = self._health_by_step.get(
+                            base + i + 1)
+                        self.watchdog.observe(base + i + 1, loss)
+                finally:
+                    self._ctx_health = None
+
+    def _emit_health_row(self):
+        """One ``health`` JSONL row per logging cadence: group names +
+        per-group arrays from the latest flushed step (obs/health.py)."""
+        h = self._last_health
+        if h is None or not self._health_names:
+            return
+        self.metrics_sink.log_health(
+            self.global_step, self._health_names,
+            grad_norm=[round(float(x), 8) for x in h["grad_norm"]],
+            param_norm=[round(float(x), 8) for x in h["param_norm"]],
+            update_norm=[round(float(x), 8) for x in h["update_norm"]],
+            update_ratio=[round(float(x), 10) for x in h["update_ratio"]],
+            first_nonfinite=nonfinite_group_name(self._health_names, h))
 
     def _stop_profiler(self):
         if self._profiling:

@@ -11,6 +11,11 @@ cd "$(dirname "$0")/.."
 if command -v ruff >/dev/null 2>&1; then
     echo "== ruff check =="
     ruff check . || exit 1
+    # obs/ + scripts are held to the full pyflakes ruleset (see the
+    # [tool.ruff.lint] comment in pyproject.toml: ruff has no per-file
+    # `select`, so the widened scope is this second invocation)
+    ruff check --extend-select F building_llm_from_scratch_tpu/obs scripts \
+        || exit 1
 else
     echo "== ruff not installed; skipping lint =="
 fi
@@ -22,6 +27,16 @@ from building_llm_from_scratch_tpu.obs.metrics import SCHEMA_VERSION
 from building_llm_from_scratch_tpu.args import get_args
 print('obs import ok, metrics schema v%d' % SCHEMA_VERSION)
 " || exit 1
+
+echo "== summarize_metrics renderer smoke (fixture JSONL) =="
+# capture-then-grep: grep -q would close the pipe early and fail the
+# renderer with BrokenPipeError under pipefail
+render_out=$(JAX_PLATFORMS=cpu python scripts/summarize_metrics.py \
+    tests/fixtures/metrics_fixture.jsonl --out /tmp/_ci_metrics.png) \
+    || exit 1
+echo "$render_out" | grep -q "per-layer-group grad norms" || exit 1
+echo "$render_out" | grep -q "compile telemetry" || exit 1
+echo "renderer ok"
 
 echo "== tier-1 tests (ROADMAP.md) =="
 rm -f /tmp/_t1.log

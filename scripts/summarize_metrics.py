@@ -5,9 +5,12 @@ artifact, this is just one renderer over it.
 
   python scripts/summarize_metrics.py out/metrics.jsonl [--out out/metrics.png]
 
-Prints the run header, per-event-kind counts, and final/peak numbers to
-stdout; writes a 2x2 figure (train/val loss, tok/s, MFU, memory) when
-matplotlib is available (text summary still works without it).
+Prints the run header, per-event-kind counts, final/peak numbers, the
+per-layer-group grad-norm trajectory (``health`` rows), the compile
+telemetry (compile seconds, HLO FLOPs, HLO-vs-analytic MFU delta,
+recompiles) and the HBM budget breakdown to stdout; writes a 2x2 figure
+(train/val loss, tok/s, MFU, memory) when matplotlib is available (text
+summary still works without it).
 """
 
 import argparse
@@ -17,7 +20,7 @@ import sys
 
 
 def load_rows(path):
-    header, metrics, events = None, [], []
+    header, metrics, events, health = None, [], [], []
     with open(path) as f:
         for i, line in enumerate(f):
             line = line.strip()
@@ -36,7 +39,9 @@ def load_rows(path):
                 metrics.append(row)
             elif kind == "event":
                 events.append(row)
-    return header, metrics, events
+            elif kind == "health":
+                health.append(row)
+    return header, metrics, events, health
 
 
 def column(rows, key):
@@ -85,6 +90,111 @@ def summarize(header, metrics, events):
         secs = [e["seconds"] for e in ckpt]
         print(f"checkpoints: {len(ckpt)} saves, "
               f"mean {sum(secs) / len(secs):.2f}s, max {max(secs):.2f}s")
+
+
+def _fmt_bytes(n):
+    return f"{n / 1024**2:.1f} MiB" if n < 1024**3 else f"{n / 1024**3:.2f} GiB"
+
+
+def summarize_compile(metrics, events):
+    """Compile-telemetry section: per-compile cost, HBM budget breakdown,
+    HLO-vs-analytic MFU delta, and any recompiles (with their shape diff)."""
+    compiles = [e for e in events if e["event"] == "compile"]
+    recompiles = [e for e in events if e["event"] == "recompile"]
+    if not (compiles or recompiles):
+        return
+    print("\n-- compile telemetry --")
+    for e in compiles:
+        flops = e.get("flops")
+        parts = [f"{e.get('label', '?')}: "
+                 f"{e.get('compile_seconds', 0):.2f}s compile"]
+        if isinstance(flops, (int, float)):
+            parts.append(f"{flops:.3g} HLO flops/step")
+        if isinstance(e.get("tokens_per_step"), (int, float)) and flops:
+            parts.append(f"{flops / e['tokens_per_step']:.3g} flops/token")
+        if "cache_hit" in e:
+            parts.append("cache HIT" if e["cache_hit"] else "cache miss")
+        print("  " + ", ".join(parts))
+        mem = e.get("memory")
+        if isinstance(mem, dict) and mem:
+            hbm = "\n".join(
+                f"    {k:<22} {_fmt_bytes(v)}" for k, v in mem.items()
+                if isinstance(v, (int, float)))
+            print("  HBM budget:\n" + hbm)
+            cap = e.get("hbm_capacity_bytes")
+            if isinstance(cap, (int, float)) and cap:
+                print(f"    {'device capacity':<22} {_fmt_bytes(cap)} "
+                      f"({100 * e.get('hbm_budget_frac', 0):.1f}% used)")
+    deltas = [r["mfu_delta"] for r in metrics
+              if isinstance(r.get("mfu_delta"), (int, float))]
+    if deltas:
+        print(f"  HLO-vs-analytic MFU delta: last {deltas[-1]:+.4f}, "
+              f"max |{max(abs(d) for d in deltas):.4f}| "
+              "(HLO counts what XLA built; a drifting delta means the "
+              "analytic formula no longer matches the graph)")
+    if recompiles:
+        print(f"  RECOMPILES: {len(recompiles)} — every one stalls the "
+              "step loop for a full XLA compile")
+        for e in recompiles:
+            for d in e.get("diff", [])[:4]:
+                print(f"    {d.get('leaf')}: {d.get('was')} -> {d.get('now')}")
+
+
+def summarize_health(health, top_k: int = 6):
+    """Per-layer-group grad-norm trajectory table: one row per health
+    cadence, one column per group (widest-swinging ``top_k`` groups when
+    there are too many to print)."""
+    rows = [h for h in health
+            if isinstance(h.get("groups"), list)
+            and isinstance(h.get("grad_norm"), list)
+            and len(h["grad_norm"]) == len(h["groups"])]
+    if not rows:
+        return
+    groups = rows[0]["groups"]
+    # concatenated/rotated telemetry can mix runs with different model
+    # depths; render the first run's shape and skip the rest instead of
+    # indexing past a shorter row
+    consistent = [h for h in rows if h["groups"] == groups]
+    dropped = len(rows) - len(consistent)
+    rows = consistent
+    print(f"\n-- per-layer-group grad norms ({len(rows)} health rows) --")
+    if dropped:
+        print(f"  ({dropped} rows with a different group layout skipped)")
+    bad = [(h["step"], h["first_nonfinite"]) for h in rows
+           if h.get("first_nonfinite")]
+    if bad:
+        for step, grp in bad:
+            print(f"  !! step {step}: first non-finite group = {grp}")
+    cols = list(range(len(groups)))
+    if len(groups) > top_k:
+        # rank groups by grad-norm dynamic range so the table shows the
+        # layers that MOVED, not an arbitrary prefix
+        def swing(i):
+            vals = [h["grad_norm"][i] for h in rows
+                    if isinstance(h["grad_norm"][i], (int, float))]
+            return (max(vals) - min(vals)) if vals else 0.0
+        cols = sorted(sorted(cols, key=swing)[-top_k:])
+        print(f"  (showing {top_k}/{len(groups)} widest-swinging groups)")
+    head = "  " + f"{'step':>8}" + "".join(
+        f"{groups[i][:12]:>14}" for i in cols)
+    print(head)
+    for h in rows:
+        cells = []
+        for i in cols:
+            v = h["grad_norm"][i]
+            cells.append(f"{v:>14.4g}" if isinstance(v, (int, float))
+                         else f"{str(v):>14}")
+        print("  " + f"{h['step']:>8}" + "".join(cells))
+    last = rows[-1]
+    ratios = last.get("update_ratio")
+    if isinstance(ratios, list) and len(ratios) == len(groups):
+        finite = [(g, r) for g, r in zip(groups, ratios)
+                  if isinstance(r, (int, float))]
+        if finite:
+            g_hi, r_hi = max(finite, key=lambda t: t[1])
+            g_lo, r_lo = min(finite, key=lambda t: t[1])
+            print(f"  update/param ratio (last row): max {g_hi} {r_hi:.2e}, "
+                  f"min {g_lo} {r_lo:.2e}")
 
 
 def plot(metrics, out_path):
@@ -144,8 +254,10 @@ def main(argv=None):
     p.add_argument("--out", default=None,
                    help="figure path (default: <jsonl dir>/metrics.png)")
     args = p.parse_args(argv)
-    header, metrics, events = load_rows(args.jsonl)
+    header, metrics, events, health = load_rows(args.jsonl)
     summarize(header, metrics, events)
+    summarize_compile(metrics, events)
+    summarize_health(health)
     if metrics:
         out = args.out or os.path.join(
             os.path.dirname(os.path.abspath(args.jsonl)), "metrics.png")

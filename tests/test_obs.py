@@ -84,8 +84,11 @@ def test_jsonl_schema_roundtrip(tmp_path):
     rows = read_rows(path)
     assert [r["type"] for r in rows] == ["header", "metrics", "event",
                                         "metrics"]
+    from building_llm_from_scratch_tpu.obs.metrics import SCHEMA_VERSION
+
     header = rows[0]
-    assert header["schema_version"] == 1 and header["device_kind"] == "test"
+    assert header["schema_version"] == SCHEMA_VERSION
+    assert header["device_kind"] == "test"
     m1, ev, m2 = rows[1], rows[2], rows[3]
     # timings drained into the first row only, counters/gauges attached
     assert m1["data_wait_s"] == pytest.approx(0.5)
@@ -301,7 +304,12 @@ def test_no_per_step_host_fetch_in_train_loop(tmp_path):
 
         def step(state, batch):
             state, metrics = real_step(state, batch)
-            return state, dict(metrics, lr=GuardedScalar(metrics["lr"]))
+            # guard the per-layer-group health arrays too: they ride the
+            # same deferred-fetch discipline as lr (cadence-only)
+            health = {k: GuardedScalar(v)
+                      for k, v in metrics["health"].items()}
+            return state, dict(metrics, lr=GuardedScalar(metrics["lr"]),
+                               health=health)
 
         trainer.train_step = step
 
@@ -315,6 +323,242 @@ def test_no_per_step_host_fetch_in_train_loop(tmp_path):
         f"host fetch outside cadence: {sorted(set(fetch_steps) - allowed)}")
     # and the lr trajectory still arrived intact
     assert len(trainer.track_lrs) == trainer.global_step
+    # health made it to the host at cadence (not never-fetched)
+    assert trainer._last_health is not None
+    assert len(trainer._health_names) == len(
+        np.asarray(trainer._last_health["grad_norm"]))
+
+
+# ---------------------------------------------------------------------------
+# Compile telemetry (obs/compile.py)
+# ---------------------------------------------------------------------------
+
+def _tiny_step_state_batch(bs=2):
+    from building_llm_from_scratch_tpu.training import (
+        build_optimizer,
+        init_train_state,
+        make_train_step,
+    )
+
+    cfg = tiny_cfg().replace(drop_rate=0.0)
+    opt = build_optimizer(total_steps=10)
+    state = init_train_state(init_params(cfg, jax.random.PRNGKey(0)), opt,
+                             jax.random.PRNGKey(1))
+    step = make_train_step(cfg, opt, lr_schedule=lambda s: 1e-3)
+    rng = np.random.default_rng(0)
+    T = cfg.context_length
+    batch = {
+        "inputs": rng.integers(0, cfg.vocab_size, (bs, T)).astype(np.int32),
+        "targets": rng.integers(0, cfg.vocab_size, (bs, T)).astype(np.int32),
+        "weights": np.ones((bs, T), np.float32),
+    }
+    return step, state, batch
+
+
+def test_compile_watcher_captures_cost_and_memory(global_sink):
+    """First call AOT-compiles and emits ONE compile event with nonzero
+    compile seconds, HLO-counted FLOPs and the HBM breakdown; steady-state
+    same-signature calls stay silent (no recompiles, no new events)."""
+    from building_llm_from_scratch_tpu.obs import CompileWatcher
+
+    _, path = global_sink
+    step, state, batch = _tiny_step_state_batch()
+    w = CompileWatcher(step, label="test_step")
+    for _ in range(3):
+        state, metrics = w(state, batch)
+    assert w.n_compiles == 1 and w.n_recompiles == 0
+    assert w.hlo_flops_per_step and w.hlo_flops_per_step > 0
+    assert w.hlo_flops_per_token == pytest.approx(
+        w.hlo_flops_per_step / batch["inputs"].size)
+    compiles = [r for r in read_rows(path) if r.get("event") == "compile"]
+    assert len(compiles) == 1
+    ev = compiles[0]
+    assert ev["label"] == "test_step"
+    assert ev["compile_seconds"] > 0
+    assert ev["flops"] > 0
+    assert ev["tokens_per_step"] == batch["inputs"].size
+    mem = ev["memory"]
+    assert mem["args_bytes"] > 0 and mem["temp_bytes"] >= 0
+    assert mem["total_bytes"] > 0
+    assert not any(r.get("event") == "recompile" for r in read_rows(path))
+    # the step result is the real one (executable actually ran)
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def _stub_aot(monkeypatch, flops=1000.0):
+    """Replace the real XLA compile with a stub so watcher-LOGIC tests
+    (recompile keying, cache counting) don't pay ~5s of compile each —
+    the end-to-end AOT path is covered once by
+    test_compile_watcher_captures_cost_and_memory."""
+    import building_llm_from_scratch_tpu.obs.compile as obs_compile
+
+    def fake_aot(fn, state, batch):
+        return (lambda s, b: (s, {"loss": np.float32(0.0)})), {
+            "compile_seconds": 0.01, "lower_seconds": 0.005,
+            "backend_compile_seconds": 0.005, "flops": flops,
+            "executable_device_count": 1,
+            "memory": {"args_bytes": 1, "temp_bytes": 2, "total_bytes": 3}}
+
+    monkeypatch.setattr(obs_compile, "aot_compile", fake_aot)
+
+
+def test_compile_watcher_detects_recompile_with_shape_diff(global_sink,
+                                                           monkeypatch):
+    """A changed batch signature fires a recompile event naming the exact
+    leaf shape diff — the silent-TPU-perf-bug detector."""
+    from building_llm_from_scratch_tpu.obs import CompileWatcher
+
+    _, path = global_sink
+    _stub_aot(monkeypatch)
+    w = CompileWatcher(lambda s, b: None, label="test_step")
+    state = {"x": np.zeros((3,), np.float32)}
+    batch2 = {"inputs": np.zeros((2, 16), np.int32)}
+    batch4 = {"inputs": np.zeros((4, 16), np.int32)}
+    state, _ = w(state, batch2)
+    state, _ = w(state, batch2)                  # steady state: silent
+    state, _ = w(state, batch4)
+    assert w.n_compiles == 2 and w.n_recompiles == 1
+    rows = read_rows(path)
+    rec = [r for r in rows if r.get("event") == "recompile"]
+    assert len(rec) == 1
+    leaves = {d["leaf"] for d in rec[0]["diff"]}
+    assert "inputs" in leaves
+    diff = next(d for d in rec[0]["diff"] if d["leaf"] == "inputs")
+    assert diff["was"]["shape"][0] == 2 and diff["now"]["shape"][0] == 4
+    assert len([r for r in rows if r.get("event") == "compile"]) == 2
+
+
+def test_compile_watcher_cache_hit_miss_counting(global_sink, tmp_path,
+                                                 monkeypatch):
+    """--compile_cache_dir telemetry: a compile that writes no new cache
+    entries into a warm dir reports a hit; an empty dir reports a miss."""
+    from building_llm_from_scratch_tpu.obs import CompileWatcher
+
+    _, path = global_sink
+    _stub_aot(monkeypatch)
+    batch = {"inputs": np.zeros((2, 16), np.int32)}
+    warm = tmp_path / "warm_cache"
+    warm.mkdir()
+    (warm / "jit_step-abc123-cache").write_bytes(b"x")
+    w = CompileWatcher(lambda s, b: None, cache_dir=str(warm))
+    w({"x": np.zeros(2)}, batch)
+    ev = [r for r in read_rows(path) if r.get("event") == "compile"][-1]
+    assert ev["cache_dir"] == str(warm)
+    assert ev["cache_entries"] == 1 and ev["cache_hit"] is True
+
+    cold = tmp_path / "cold_cache"
+    cold.mkdir()
+    w2 = CompileWatcher(lambda s, b: None, cache_dir=str(cold))
+    w2({"x": np.zeros(2)}, batch)
+    ev2 = [r for r in read_rows(path) if r.get("event") == "compile"][-1]
+    assert ev2["cache_hit"] is False
+
+
+def test_compile_watcher_falls_back_on_unloweable_step(global_sink):
+    """Telemetry must never take down the run: a step without .lower()
+    (or whose AOT path raises) delegates to the wrapped callable and emits
+    a compile_fallback event."""
+    from building_llm_from_scratch_tpu.obs import CompileWatcher
+
+    _, path = global_sink
+    calls = []
+
+    def plain_step(state, batch):                  # no .lower attribute
+        calls.append(1)
+        return state, {"loss": 0.0}
+
+    w = CompileWatcher(plain_step, label="plain")
+    state, m = w({"x": np.zeros(2)}, {"inputs": np.zeros((2, 4))})
+    assert m["loss"] == 0.0 and len(calls) == 1
+    assert w._disabled
+    w(state, {"inputs": np.zeros((2, 4))})         # stays delegated
+    assert len(calls) == 2
+    events = [r.get("event") for r in read_rows(path)]
+    assert "compile_fallback" in events
+    assert "compile" not in events
+
+
+def test_aot_cost_analysis_globalized_over_devices():
+    """cost_analysis() reports the PER-DEVICE SPMD module; aot_compile must
+    scale it by the executable's device span so mfu_hlo (global FLOPs /
+    global tokens) is right on multi-chip runs, not just single-chip."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from building_llm_from_scratch_tpu.obs.compile import (
+        aot_compile,
+        executable_device_count,
+    )
+
+    a = jax.numpy.ones((64, 128))
+    b = jax.numpy.ones((128, 32))
+    c1, s1 = aot_compile(jax.jit(lambda a, b: a @ b), a, b)
+    assert executable_device_count(c1) == 1
+    assert s1["executable_device_count"] == 1
+    assert "flops_per_device" not in s1
+
+    n = len(jax.devices())
+    assert n == 8, "conftest forces an 8-device CPU platform"
+    mesh = Mesh(np.array(jax.devices()).reshape(n), ("data",))
+    sharded = NamedSharding(mesh, P("data"))
+    rep = NamedSharding(mesh, P())
+    f8 = jax.jit(lambda a, b: a @ b, in_shardings=(sharded, rep),
+                 out_shardings=sharded)
+    c8, s8 = aot_compile(f8, jax.device_put(a, sharded),
+                         jax.device_put(b, rep))
+    assert s8["executable_device_count"] == n
+    # per-device module counted 1/n of the work; stats carry the GLOBAL sum
+    assert s8["flops_per_device"] == pytest.approx(s1["flops"] / n, rel=0.01)
+    assert s8["flops"] == pytest.approx(s1["flops"], rel=0.01)
+
+
+def test_signature_diff_names_changed_leaves():
+    from building_llm_from_scratch_tpu.obs.compile import (
+        signature_diff,
+        tree_signature,
+    )
+
+    a = tree_signature({"x": np.zeros((2, 4), np.float32),
+                        "y": np.zeros((3,), np.int32)})
+    b = tree_signature({"x": np.zeros((8, 4), np.float32),
+                        "y": np.zeros((3,), np.int32)})
+    diff = signature_diff(a, b)
+    assert len(diff) == 1 and diff[0]["leaf"] == "x"
+    assert diff[0]["was"]["shape"] == [2, 4]
+    assert diff[0]["now"]["shape"] == [8, 4]
+    assert signature_diff(a, a) == []
+
+
+def test_watchdog_halt_names_offending_layer(global_sink):
+    """The trainer wires obs/health's digest as the watchdog context: the
+    halt event + diagnostic name the first non-finite layer group."""
+    from building_llm_from_scratch_tpu.training.resilience import (
+        LossWatchdog,
+        TrainingDivergedError,
+    )
+
+    _, path = global_sink
+    wd = LossWatchdog(context_fn=lambda: {
+        "first_nonfinite_group": "block_01",
+        "top_grad_norm_groups": [{"group": "block_01", "grad_norm": 12.5}]})
+    with pytest.raises(TrainingDivergedError, match="block_01"):
+        wd.observe(7, float("nan"))
+    halt = next(r for r in read_rows(path)
+                if r.get("event") == "watchdog_halt")
+    assert halt["first_nonfinite_group"] == "block_01"
+    assert halt["top_grad_norm_groups"][0]["group"] == "block_01"
+    # a broken context provider must not mask the halt itself
+    wd2 = LossWatchdog(context_fn=lambda: 1 / 0)
+    with pytest.raises(TrainingDivergedError):
+        wd2.observe(8, float("inf"))
+    # nor may a context key that collides with the event's own kwargs
+    # (reason/recent/step) turn the halt into a TypeError
+    wd3 = LossWatchdog(context_fn=lambda: {
+        "reason": "shadow", "step": 0, "first_nonfinite_group": "head"})
+    with pytest.raises(TrainingDivergedError):
+        wd3.observe(9, float("nan"))
+    halts = [r for r in read_rows(path) if r.get("event") == "watchdog_halt"]
+    assert halts[-1]["reason"] == "non_finite"       # event kwarg wins
+    assert halts[-1]["first_nonfinite_group"] == "head"
 
 
 # ---------------------------------------------------------------------------
@@ -543,6 +787,9 @@ def test_cli_smoke_metrics_jsonl(tmp_path):
         assert r["lr"] is not None and r["tok_s"] > 0
         assert r["step_time_s"] is not None
         assert r["host_rss_bytes"] > 0
+        # pre-clip grad norm + post-clip update norm (derived from the
+        # health bundle) surface in every metrics row
+        assert r["grad_norm"] > 0 and r["update_norm"] > 0
     # loss only on eval-cadence rows
     eval_rows = [r for r in metrics if r["step"] % 10 == 0]
     assert eval_rows and all(
@@ -556,3 +803,31 @@ def test_cli_smoke_metrics_jsonl(tmp_path):
     assert "run_complete" in events
     ckpt = next(r for r in rows if r.get("event") == "checkpoint_save")
     assert ckpt["bytes"] > 0 and ckpt["seconds"] > 0
+
+    # compile telemetry (acceptance): exactly ONE compile event — nonzero
+    # compile seconds, HLO cost-analysis FLOPs, a memory breakdown — and
+    # ZERO recompiles across the fixed-shape run
+    compiles = [r for r in rows if r.get("event") == "compile"]
+    assert len(compiles) == 1, [r.get("event") for r in rows
+                                if r["type"] == "event"]
+    ev = compiles[0]
+    assert ev["compile_seconds"] > 0
+    assert ev["flops"] > 0
+    assert ev["memory"]["total_bytes"] > 0
+    assert ev["tokens_per_step"] == 8 * trainer.cfg.context_length
+    assert not [r for r in rows if r.get("event") == "recompile"]
+
+    # health rows (acceptance): per-layer-group arrays at the log cadence
+    health = [r for r in rows if r["type"] == "health"]
+    assert health, "no health rows"
+    groups = health[0]["groups"]
+    assert [g for g in groups if g.startswith("block_")]
+    for r in health:
+        assert r["groups"] == groups
+        for key in ("grad_norm", "param_norm", "update_norm",
+                    "update_ratio"):
+            assert len(r[key]) == len(groups)
+            assert all(np.isfinite(v) for v in r[key])
+        assert r["first_nonfinite"] is None
+    hsteps = [r["step"] for r in health]
+    assert hsteps == sorted(hsteps) and 5 in hsteps and 10 in hsteps

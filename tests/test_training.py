@@ -82,6 +82,19 @@ def test_grad_accum_matches_full_batch(masked):
         s2, m2 = step4(s2, b)
         np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]),
                                    rtol=1e-5, atol=1e-6)
+        # per-layer-group health arrays (obs/health.py) must agree between
+        # the paths too: the scan accumulates the same gradient, so every
+        # group's grad/param/update norm is the same number
+        for key in ("grad_norm", "param_norm", "update_norm",
+                    "update_ratio"):
+            np.testing.assert_allclose(
+                np.asarray(m1["health"][key]), np.asarray(m2["health"][key]),
+                rtol=2e-4, atol=1e-7, err_msg=f"health {key} diverged")
+        assert int(m1["health"]["first_nonfinite"]) == -1
+        assert int(m2["health"]["first_nonfinite"]) == -1
+        np.testing.assert_allclose(float(m1["update_norm"]),
+                                   float(m2["update_norm"]),
+                                   rtol=2e-4, atol=1e-7)
     for a, b in zip(jax.tree_util.tree_leaves(s1["trainable"]),
                     jax.tree_util.tree_leaves(s2["trainable"])):
         # adam's rsqrt amplifies fp32 reduction-order noise over 3 steps
@@ -116,6 +129,104 @@ def test_grad_accum_rejects_indivisible_batch():
     step = make_train_step(cfg, opt, grad_accum=3, jit=False)
     with pytest.raises(ValueError, match="divisible"):
         step(state, make_batch(cfg, bs=4))
+
+
+# ---------------------------------------------------------------------------
+# Per-layer-group training health (obs/health.py via _finish_step)
+# ---------------------------------------------------------------------------
+
+def test_step_metrics_carry_health_and_update_norm():
+    """Every step's metrics pytree carries the health bundle — (G,) arrays
+    aligned with obs.health.group_names — plus the post-clip update_norm
+    satellite (clipping was previously invisible)."""
+    from building_llm_from_scratch_tpu.obs.health import group_names
+
+    # shrunk well below the debug config: this test compiles its own step
+    # and only checks metric plumbing, not model numerics
+    cfg = tiny_cfg().replace(drop_rate=0.0, emb_dim=32, hidden_dim=64,
+                             n_layers=2, n_heads=2, vocab_size=257,
+                             context_length=16)
+    opt = build_optimizer(total_steps=10)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    names = group_names(params)
+    # GPT-2 debug config: 2 stacked blocks + embeddings/norm/head groups
+    assert [n for n in names if n.startswith("block_")] == [
+        f"block_{i:02d}" for i in range(cfg.n_layers)]
+    assert {"tok_emb", "head", "final_norm"} <= set(names)
+    state = init_train_state(params, opt, jax.random.PRNGKey(1))
+    step = make_train_step(cfg, opt)
+    state, m = step(state, make_batch(cfg, bs=2))
+    h = m["health"]
+    G = len(names)
+    for key in ("grad_norm", "param_norm", "update_norm", "update_ratio"):
+        arr = np.asarray(h[key])
+        assert arr.shape == (G,), key
+        assert np.all(np.isfinite(arr)), key
+    assert int(h["first_nonfinite"]) == -1
+    # group norms compose to the global ones reported alongside them
+    np.testing.assert_allclose(
+        np.sqrt(np.sum(np.asarray(h["grad_norm"]) ** 2)),
+        float(m["grad_norm"]), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.sqrt(np.sum(np.asarray(h["update_norm"]) ** 2)),
+        float(m["update_norm"]), rtol=1e-5)
+    assert float(m["update_norm"]) > 0.0
+
+
+def test_health_group_norms_match_hand_computation():
+    """grad_norm[g] is the plain L2 norm over the group's leaves; the
+    stacked `blocks` leaves split per layer along their leading axis."""
+    from building_llm_from_scratch_tpu.obs.health import (
+        group_health,
+        group_names,
+    )
+
+    tree = {
+        "blocks": {"w": jnp.asarray([[3.0, 4.0], [5.0, 12.0]])},  # L=2
+        "head": {"weight": jnp.asarray([8.0, -6.0])},
+    }
+    names = group_names(tree)
+    assert names == ["block_00", "block_01", "head"]
+    h = group_health(tree, tree, tree)
+    np.testing.assert_allclose(np.asarray(h["grad_norm"]),
+                               [5.0, 13.0, 10.0], rtol=1e-6)
+    # identical trees -> update/param ratio is exactly 1
+    np.testing.assert_allclose(np.asarray(h["update_ratio"]),
+                               [1.0, 1.0, 1.0], rtol=1e-6)
+    assert int(h["first_nonfinite"]) == -1
+
+
+def test_health_first_nonfinite_names_injected_layer():
+    """Localization: a NaN injected into ONE block's gradient leaf maps to
+    that block's group index — the watchdog_halt attachment names it."""
+    from building_llm_from_scratch_tpu.obs.health import (
+        first_nonfinite_group,
+        group_health,
+        group_names,
+    )
+
+    cfg = tiny_cfg().replace(drop_rate=0.0)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    names = group_names(params)
+    grads = jax.tree_util.tree_map(jnp.zeros_like, params)
+    layer = 1
+    wq = np.zeros(grads["blocks"]["attn"]["wq"].shape, np.float32)
+    wq[layer, 0, 0] = np.nan
+    grads["blocks"]["attn"]["wq"] = jnp.asarray(wq)
+    idx = int(first_nonfinite_group(grads))
+    assert names[idx] == f"block_{layer:02d}"
+    # an inf in an EARLIER group wins (first = lowest group index)
+    head = np.zeros(np.asarray(grads["head"]["weight"]).shape, np.float32)
+    head[0] = np.inf
+    grads2 = dict(grads, head={"weight": jnp.asarray(head)})
+    first = int(first_nonfinite_group(grads2))
+    assert first == min(idx, names.index("head"))
+    # the full bundle agrees with the standalone helper
+    h = group_health(grads, params, grads)
+    assert int(h["first_nonfinite"]) == idx
+    # healthy grads localize to -1
+    zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+    assert int(first_nonfinite_group(zeros)) == -1
 
 
 # ---------------------------------------------------------------------------
