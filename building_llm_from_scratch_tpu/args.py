@@ -188,6 +188,8 @@ def perform_checks(args) -> None:
             "(expected 'auto', 'off', or a checkpoint directory).")
     if args.keep_ckpts < 0:
         raise ValueError("--keep_ckpts must be >= 0 (0 keeps all).")
+    if args.prefetch < 0:
+        raise ValueError("--prefetch must be >= 0 (0 disables).")
     if args.log_every < 0:
         raise ValueError("--log_every must be >= 0 (0 = eval cadence).")
     if args.stall_timeout < 0:
@@ -248,6 +250,32 @@ def get_args(argv=None):
                         help="Initial learning rate before warmup.")
     parser.add_argument("--min_lr", type=float, default=1e-6,
                         help="Minimum learning rate.")
+
+    # Host/device overlap (data/prefetch.py, training/async_checkpoint.py)
+    parser.add_argument("--prefetch", type=int, default=2,
+                        help="Batch-prefetch depth: a background thread "
+                             "keeps this many already-transferred device "
+                             "batches queued so the H2D copy for batch "
+                             "k+1 overlaps the step for batch k (2 = "
+                             "double buffering). Exact batch order and "
+                             "cursor resume are preserved. 0 disables "
+                             "(strict synchronous path, e.g. for "
+                             "debugging).")
+    parser.add_argument("--async_ckpt", type=str, default="off",
+                        choices=["on", "off"],
+                        help="Write periodic checkpoints on a background "
+                             "thread: the step loop pays only the host "
+                             "snapshot, the shard/manifest/commit I/O "
+                             "overlaps training. Exit-path checkpoints "
+                             "(final/interrupted) still block until "
+                             "durable. Multi-host runs fall back to "
+                             "synchronous saves.")
+    parser.add_argument("--tokenizer_cache_dir", type=str, default=None,
+                        help="Persist per-file token-id caches here "
+                             "(.npz): relaunches (the preemption-resume "
+                             "loop) skip re-tokenizing the corpus. "
+                             "In-memory tokenize-once caching is always "
+                             "on regardless.")
 
     # Logging & Evaluation
     parser.add_argument("--print_sample_iter", type=int, default=10,

@@ -183,7 +183,13 @@ def build_components(args) -> Components:
                # the compile event's HLO-counted figure is compared against
                flops_per_token_analytic=flops_per_token(cfg),
                shard_mode=getattr(args, "shard_mode", None),
-               load_weights=bool(args.load_weights))
+               load_weights=bool(args.load_weights),
+               # host-overlap config, so a postmortem can tell at a glance
+               # whether a slow run even had the overlap machinery on
+               prefetch=getattr(args, "prefetch", None),
+               async_ckpt=getattr(args, "async_ckpt", None),
+               tokenizer_cache=bool(getattr(args, "tokenizer_cache_dir",
+                                            None)))
 
     lora_params = None
     if args.use_lora:

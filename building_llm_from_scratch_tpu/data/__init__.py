@@ -10,8 +10,10 @@ from building_llm_from_scratch_tpu.data.tokenizers import (
 from building_llm_from_scratch_tpu.data.pretrain import (
     PretrainDataset,
     PretrainLoader,
+    TokenCache,
     make_windows,
 )
+from building_llm_from_scratch_tpu.data.prefetch import Prefetcher
 from building_llm_from_scratch_tpu.data.instruct import (
     InstructionDataset,
     InstructLoader,
@@ -26,8 +28,10 @@ __all__ = [
     "Llama2Tokenizer",
     "Llama3Tokenizer",
     "build_tokenizer",
+    "Prefetcher",
     "PretrainDataset",
     "PretrainLoader",
+    "TokenCache",
     "make_windows",
     "InstructionDataset",
     "InstructLoader",

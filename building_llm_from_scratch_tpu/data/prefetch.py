@@ -1,0 +1,206 @@
+"""Host/device overlap: a bounded background-thread batch prefetcher.
+
+The synchronous step loop serializes three phases per step: build the next
+numpy batch on the host, transfer it host->device, then dispatch the jitted
+step. The device idles through the first two. ``Prefetcher`` moves them off
+the critical path: a worker thread pulls batches from the underlying
+iterator, performs the sharded device placement itself (``place_fn`` — the
+trainer passes ``MeshPlan.shard_batch`` /
+``jax.make_array_from_process_local_data`` wiring), and keeps up to
+``depth`` already-placed batches in a bounded queue. With ``depth >= 2``
+the H2D DMA for batch k+1 overlaps the device step for batch k and the
+consumer's ``data_wait`` collapses to queue-pop time.
+
+Contracts (the trainer and the resume machinery depend on all of them):
+
+  - **Exact order.** One worker, one FIFO queue: batches arrive in the
+    source iterator's order, bit-identical to the synchronous path. The
+    PR-1 data-cursor resume therefore keeps working — callers apply the
+    skip-count fast-forward (``itertools.islice``) BEFORE wrapping the
+    iterator, so the queue only ever fills with batches that will train.
+  - **No leaked threads.** ``close()`` (idempotent, also the context-
+    manager exit) signals the worker, drains the queue so a blocked
+    ``put`` wakes, and joins. The trainer closes in a ``finally`` so a
+    GracefulStopper stop, a watchdog halt, or any exception unwinding the
+    epoch tears the worker down.
+  - **Exceptions propagate.** A worker-side exception (tokenizer error,
+    OOM in placement, ...) is captured and re-raised at the consumer's
+    next ``__next__`` — never swallowed, never hung.
+  - **Telemetry.** ``stalls`` counts pops that found the queue empty while
+    the worker was still producing (the genuinely host-starved case;
+    the initial fill is excluded), ``fill_sum``/``pops`` give the mean
+    queue depth — the trainer turns counter deltas into the per-window
+    ``prefetch_stall`` / ``prefetch_fill_ratio`` metrics fields.
+
+``place_in_worker=False`` keeps the queue host-side and applies
+``place_fn`` at pop time instead: the forced-host-platform CPU backend
+CHECK-aborts when multi-device placement races in-flight donated steps
+(see ``Trainer._flush_metrics``'s round-4 note), so the trainer only
+places from the worker thread when the backend is a real accelerator or
+the run is single-device. The host-side work (read/tokenize/window/
+shuffle/collate) still overlaps either way.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+from building_llm_from_scratch_tpu.utils.logging import setup_logger
+
+logger = setup_logger(__name__)
+
+#: Queue sentinel: the worker finished the source iterator (or died — then
+#: ``_exc`` is set). A plain object() so user batches can never collide.
+_DONE = object()
+
+
+class Prefetcher:
+    """Iterate ``source`` through a bounded background-thread queue.
+
+    Parameters
+    ----------
+    source:
+        Any iterable of batches (numpy tuples, dicts, ...).
+    depth:
+        Max batches in flight (queue capacity), >= 1. Depth 2 is classic
+        double buffering; 3 adds slack for jittery per-batch host time.
+    place_fn:
+        Optional transform applied exactly once per batch (the trainer's
+        device placement). Where it runs is ``place_in_worker``.
+    place_in_worker:
+        True (default): ``place_fn`` runs on the worker thread, so the
+        queue holds already-placed device batches and the H2D transfer
+        overlaps the device step. False: the queue holds host batches and
+        ``place_fn`` runs at pop time (see module docstring).
+    name:
+        Thread-name suffix for stack dumps (obs/stall.py flight recorder).
+    """
+
+    def __init__(self, source: Iterable[Any], depth: int = 2, *,
+                 place_fn: Optional[Callable[[Any], Any]] = None,
+                 place_in_worker: bool = True, name: str = "prefetch"):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self.depth = depth
+        self._place_fn = place_fn
+        self._place_in_worker = place_in_worker
+        self._src = iter(source)
+        self._q: "queue.Queue[Any]" = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._exc: Optional[BaseException] = None
+        self._closed = False
+        self._finished = False
+        # telemetry counters (read by the trainer at logging cadence)
+        self.stalls = 0
+        self.pops = 0
+        self.fill_sum = 0
+        self._thread = threading.Thread(target=self._fill, daemon=True,
+                                        name=f"{name}-worker")
+        self._thread.start()
+
+    # -- worker --------------------------------------------------------
+
+    def _put(self, item: Any) -> bool:
+        """Bounded put that stays responsive to ``close()``: a worker
+        blocked forever in ``Queue.put`` on a full queue could never be
+        joined. Returns False when cancelled."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _fill(self) -> None:
+        try:
+            for item in self._src:
+                if self._stop.is_set():
+                    return
+                if self._place_fn is not None and self._place_in_worker:
+                    item = self._place_fn(item)
+                if not self._put(item):
+                    return
+        except BaseException as e:          # noqa: BLE001 — re-raised at pop
+            self._exc = e
+        finally:
+            # always terminate the stream: the consumer's blocking get()
+            # must wake whether the source ended, raised, or was cancelled
+            self._put(_DONE)
+
+    # -- consumer ------------------------------------------------------
+
+    def __iter__(self) -> Iterator[Any]:
+        return self
+
+    def __next__(self) -> Any:
+        if self._finished:
+            raise StopIteration
+        qsize = self._q.qsize()
+        # a pop that finds the queue empty while the worker is still
+        # producing a real batch = the host can't keep up (prefetch_stall).
+        # Two exclusions: the FIRST pop (initial fill is startup latency,
+        # not steady-state starvation) and a pop whose wait turns out to be
+        # for the end-of-stream sentinel (nothing was starved — the source
+        # is simply done, and counting it would make the final pop of every
+        # healthy epoch race a spurious stall).
+        would_stall = qsize == 0 and self.pops > 0
+        item = self._q.get()
+        if item is _DONE:
+            self._finished = True
+            self._thread.join(timeout=5.0)
+            if self._exc is not None:
+                exc, self._exc = self._exc, None
+                raise exc
+            raise StopIteration
+        if would_stall:
+            self.stalls += 1
+        self.fill_sum += qsize
+        self.pops += 1
+        if self._place_fn is not None and not self._place_in_worker:
+            item = self._place_fn(item)
+        return item
+
+    # -- lifecycle / introspection ------------------------------------
+
+    def qsize(self) -> int:
+        return self._q.qsize()
+
+    @property
+    def alive(self) -> bool:
+        return self._thread.is_alive()
+
+    def counters(self) -> dict:
+        """Snapshot of the telemetry counters (trainer computes window
+        deltas between snapshots)."""
+        return {"stalls": self.stalls, "pops": self.pops,
+                "fill_sum": self.fill_sum}
+
+    def close(self) -> None:
+        """Cancel and join the worker. Idempotent; safe mid-iteration
+        (preemption stop, watchdog halt, exception unwind)."""
+        if self._closed:
+            return
+        self._closed = True
+        self._finished = True
+        self._stop.set()
+        # drain so a worker blocked in put() (full queue) cycles its
+        # timeout and sees the stop flag promptly
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        self._thread.join(timeout=10.0)
+        if self._thread.is_alive():          # pragma: no cover — deadlock aid
+            logger.warning("Prefetch worker did not join within 10s; "
+                           "leaving daemon thread to die with the process.")
+
+    def __enter__(self) -> "Prefetcher":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False

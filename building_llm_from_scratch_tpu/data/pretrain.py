@@ -13,43 +13,201 @@ always, so every jit'd step sees one shape); sharding across data-parallel
 processes is an index stride over the global batch stream (replacing torch's
 DistributedSampler), handled by the caller via ``process_index``/
 ``process_count``.
+
+Host-overlap round additions:
+  - ``make_windows`` returns ZERO-COPY ``sliding_window_view`` views over
+    the token array instead of materializing an (N, T) gather-index array
+    plus full window copies: resident host memory per corpus file is one
+    token array (1x), not windows + tokens (~2x+), and the per-batch copy
+    happens at yield time via fancy indexing in ``batches``.
+  - ``TokenCache``: a per-(file, tokenizer, max_length, stride,
+    train_ratio) token-id cache so the total-steps pre-pass and every
+    subsequent epoch reuse ONE tokenization per file instead of re-reading
+    and re-encoding the whole corpus each time. In-memory always; with a
+    ``cache_dir`` the ids also persist as ``.npz`` across relaunches
+    (``--tokenizer_cache_dir``), keyed by file identity (path, mtime,
+    size) so an edited corpus re-tokenizes. The train/val ids are cached
+    as the PAIR produced by the char-level split — BPE is not
+    concatenation-stable, so caching the full text's ids and re-splitting
+    token-side would change batches.
 """
 
 from __future__ import annotations
 
+import hashlib
+import os
+import time
 from typing import Iterator, List, Optional, Tuple
 
 import numpy as np
 
+from building_llm_from_scratch_tpu.obs.metrics import emit_event
+from building_llm_from_scratch_tpu.utils.logging import setup_logger
+
+logger = setup_logger(__name__)
+
 
 def make_windows(token_ids: np.ndarray, max_length: int,
                  stride: int) -> Tuple[np.ndarray, np.ndarray]:
-    """Materialize sliding windows: inputs (N, T) and shifted targets (N, T).
+    """Sliding windows: inputs (N, T) and shifted targets (N, T).
 
     Reference: datautils/dataset.py:29-34 (windows of ``max_length`` every
     ``stride`` tokens; partial trailing windows dropped).
+
+    Both returned arrays are read-only **views** over ``token_ids``
+    (``np.lib.stride_tricks.sliding_window_view``): no index array, no
+    window copies — resident memory is the token array alone. Consumers
+    that batch by fancy indexing (``inputs[rows]``) get a fresh writable
+    copy of just that batch, which is exactly the copy-at-yield-time
+    contract the loader wants.
     """
-    token_ids = np.asarray(token_ids, dtype=np.int32)
+    token_ids = np.ascontiguousarray(token_ids, dtype=np.int32)
     n = len(token_ids) - max_length          # need max_length+1 tokens per row
     if n <= 0:
         return (np.zeros((0, max_length), np.int32),
                 np.zeros((0, max_length), np.int32))
-    starts = np.arange(0, n, stride)
-    idx = starts[:, None] + np.arange(max_length)[None, :]
-    return token_ids[idx], token_ids[idx + 1]
+    # windows of max_length+1 every `stride`, then split into the
+    # input/target halves — two overlapping views, zero copies
+    win = np.lib.stride_tricks.sliding_window_view(
+        token_ids, max_length + 1)[:n:stride]
+    return win[:, :-1], win[:, 1:]
 
 
 class PretrainDataset:
     """Tokenize once, window lazily (reference DatasetPT, datautils/dataset.py:6)."""
 
-    def __init__(self, text: str, tokenizer, max_length: int, stride: int):
-        ids = tokenizer.encode(text, allowed_special={"<|endoftext|>"})
-        self.token_ids = np.asarray(ids, dtype=np.int32)
+    def __init__(self, text: Optional[str], tokenizer, max_length: int,
+                 stride: int, token_ids: Optional[np.ndarray] = None):
+        if token_ids is None:
+            ids = tokenizer.encode(text, allowed_special={"<|endoftext|>"})
+            token_ids = np.asarray(ids, dtype=np.int32)
+        self.token_ids = np.asarray(token_ids, dtype=np.int32)
         self.inputs, self.targets = make_windows(self.token_ids, max_length,
                                                  stride)
 
+    @classmethod
+    def from_token_ids(cls, token_ids: np.ndarray, max_length: int,
+                       stride: int) -> "PretrainDataset":
+        """Build from already-tokenized ids (the TokenCache hit path)."""
+        return cls(None, None, max_length, stride, token_ids=token_ids)
+
     def __len__(self) -> int:
         return len(self.inputs)
+
+
+def _num_windows(n_tokens: int, max_length: int, stride: int) -> int:
+    """len(PretrainDataset) without building it: window count of
+    ``make_windows`` over ``n_tokens`` tokens."""
+    n = n_tokens - max_length
+    return 0 if n <= 0 else len(range(0, n, stride))
+
+
+class TokenCache:
+    """Tokenize-once cache for the pretrain path.
+
+    One entry per (file identity, tokenizer, max_length, stride,
+    train_ratio): the (train_ids, val_ids) pair the char-level split
+    produces. ``max_length``/``stride`` don't change tokenization, but
+    they key the entry anyway so a cache_dir shared across runs with
+    different windowing never aliases by accident. File identity is
+    (abspath, mtime_ns, size) — an edited corpus misses and re-encodes.
+    """
+
+    def __init__(self, cache_dir: Optional[str] = None):
+        self.cache_dir = cache_dir
+        self._mem: dict = {}
+
+    #: Probe text for the tokenizer fingerprint: mixed case, digits,
+    #: punctuation and whitespace so two different vocab files (same class,
+    #: same vocab_size — e.g. two sentencepiece models) encode it
+    #: differently with overwhelming probability.
+    _PROBE = "The 3 quick brown foxes JUMPED over 42 lazy dogs!?\n\t'"
+
+    @classmethod
+    def _tokenizer_id(cls, tokenizer) -> str:
+        # class name + vocab_size alone alias across tokenizer ASSETS (two
+        # sp/BPE models with equal vocab sizes): fingerprint an actual
+        # encoding so a shared --tokenizer_cache_dir never serves ids from
+        # the wrong vocabulary. Probed once per tokenizer instance.
+        fp = getattr(tokenizer, "_token_cache_fp", None)
+        if fp is None:
+            try:
+                ids = tokenizer.encode(cls._PROBE)
+                fp = hashlib.sha256(
+                    np.asarray(ids, np.int64).tobytes()).hexdigest()[:12]
+            except Exception:  # exotic encode() signature: fall back to
+                fp = "noprobe"  # class+vocab keying only
+            try:
+                tokenizer._token_cache_fp = fp
+            except Exception:   # __slots__ etc.: re-probe per call
+                pass
+        return (f"{type(tokenizer).__name__}"
+                f"-v{getattr(tokenizer, 'vocab_size', '')}-{fp}")
+
+    def _key(self, path: str, tokenizer, max_length: int, stride: int,
+             train_ratio: float, eos_text: str) -> tuple:
+        st = os.stat(path)
+        return (os.path.abspath(path), st.st_mtime_ns, st.st_size,
+                self._tokenizer_id(tokenizer), int(max_length), int(stride),
+                round(float(train_ratio), 6), eos_text)
+
+    def _disk_path(self, key: tuple) -> Optional[str]:
+        if self.cache_dir is None:
+            return None
+        digest = hashlib.sha256(repr(key).encode()).hexdigest()[:32]
+        return os.path.join(self.cache_dir, f"tok_{digest}.npz")
+
+    def get(self, path: str, tokenizer, max_length: int, stride: int,
+            train_ratio: float, eos_text: str, encode_fn
+            ) -> Tuple[np.ndarray, np.ndarray]:
+        """(train_ids, val_ids) for ``path``, tokenizing at most once.
+
+        ``encode_fn(path) -> (train_ids, val_ids)`` runs only on a miss
+        (the loader passes its read+split+encode closure).
+        """
+        try:
+            key = self._key(path, tokenizer, max_length, stride, train_ratio,
+                            eos_text)
+        except OSError:
+            # path not stat-able (synthetic read_fn feeds): no identity to
+            # key on, so skip caching rather than alias entries
+            return encode_fn(path)
+        hit = self._mem.get(key)
+        if hit is not None:
+            return hit
+        disk = self._disk_path(key)
+        if disk is not None and os.path.isfile(disk):
+            try:
+                with np.load(disk) as z:
+                    pair = (np.asarray(z["train"], np.int32),
+                            np.asarray(z["val"], np.int32))
+                self._mem[key] = pair
+                emit_event("tokenize_cache", file=os.path.basename(path),
+                           source="disk", tokens=int(pair[0].size
+                                                     + pair[1].size))
+                return pair
+            except Exception as e:   # corrupt cache file: re-tokenize
+                logger.warning("Token cache %s unreadable (%s); "
+                               "re-tokenizing.", disk, e)
+        t0 = time.perf_counter()
+        pair = encode_fn(path)
+        pair = (np.asarray(pair[0], np.int32), np.asarray(pair[1], np.int32))
+        self._mem[key] = pair
+        emit_event("tokenize_cache", file=os.path.basename(path),
+                   source="encoded", tokens=int(pair[0].size + pair[1].size),
+                   seconds=round(time.perf_counter() - t0, 4))
+        if disk is not None:
+            try:
+                os.makedirs(self.cache_dir, exist_ok=True)
+                tmp = disk + ".tmp"
+                np.savez(tmp, train=pair[0], val=pair[1])
+                # np.savez appends .npz to paths without it
+                os.replace(tmp if os.path.exists(tmp) else tmp + ".npz",
+                           disk)
+            except OSError as e:     # cache write failure must not kill a run
+                logger.warning("Token cache write to %s failed (%s).",
+                               disk, e)
+        return pair
 
 
 class PretrainLoader:
@@ -62,7 +220,7 @@ class PretrainLoader:
     def __init__(self, tokenizer, batch_size: int, max_length: int,
                  stride: Optional[int] = None, train_ratio: float = 0.90,
                  process_index: int = 0, process_count: int = 1,
-                 seed: int = 123):
+                 seed: int = 123, token_cache_dir: Optional[str] = None):
         self.tokenizer = tokenizer
         self.batch_size = batch_size
         self.max_length = max_length
@@ -71,6 +229,7 @@ class PretrainLoader:
         self.process_index = process_index
         self.process_count = process_count
         self.seed = seed
+        self.token_cache = TokenCache(token_cache_dir)
 
     def split_text(self, text: str) -> Tuple[str, str]:
         """Char-level 90/10 split (reference dataloader.py:70)."""
@@ -86,6 +245,40 @@ class PretrainLoader:
                               self.stride)
         return train, val
 
+    def _file_token_ids(self, path: str, eos_text: str, read_fn=None
+                        ) -> Tuple[np.ndarray, np.ndarray]:
+        """(train_ids, val_ids) for one corpus file + trailing eos,
+        through the tokenize-once cache."""
+        from building_llm_from_scratch_tpu.utils.io import read_text_file
+
+        read_fn = read_fn or read_text_file
+
+        def encode(p: str) -> Tuple[np.ndarray, np.ndarray]:
+            text = read_fn(p) + f" {eos_text} "
+            train_text, val_text = self.split_text(text)
+            enc = lambda t: np.asarray(
+                self.tokenizer.encode(t,
+                                      allowed_special={"<|endoftext|>"}),
+                np.int32)
+            return enc(train_text), enc(val_text)
+
+        return self.token_cache.get(path, self.tokenizer, self.max_length,
+                                    self.stride, self.train_ratio, eos_text,
+                                    encode)
+
+    def create_datasets_for_file(self, path: str, eos_text: str,
+                                 read_fn=None
+                                 ) -> Tuple[PretrainDataset, PretrainDataset]:
+        """Datasets for one corpus file (+ the `` {eos_text} `` suffix the
+        trainer appends, reference train.py:164-165), tokenizing each file
+        at most once per run — epoch 2+ and the total-steps pre-pass are
+        cache hits, not a re-read + re-encode of the whole corpus."""
+        train_ids, val_ids = self._file_token_ids(path, eos_text, read_fn)
+        return (PretrainDataset.from_token_ids(train_ids, self.max_length,
+                                               self.stride),
+                PretrainDataset.from_token_ids(val_ids, self.max_length,
+                                               self.stride))
+
     def batches(self, dataset: PretrainDataset, *, shuffle: bool = True,
                 epoch: int = 0) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
         """Yield fixed-shape (inputs, targets) batches of this process's shard.
@@ -93,39 +286,48 @@ class PretrainLoader:
         Shuffling is deterministic in (seed, epoch) on every process — the
         ``sampler.set_epoch`` pattern (reference train.py:169-170) — and each
         process takes a strided slice of the global batch order.
+
+        ``dataset.inputs``/``.targets`` are zero-copy window views; the
+        fancy-indexed gather below is where (and only where) each batch's
+        rows materialize.
         """
         n = len(dataset)
         order = np.arange(n)
         if shuffle:
             rng = np.random.default_rng(self.seed + epoch)
             rng.shuffle(order)
-        # drop_last semantics: only full global batches (fixed XLA shapes)
         global_bs = self.batch_size * self.process_count
-        n_batches = n // global_bs
+        n_batches = self._num_global_batches(n)
         for b in range(n_batches):
             sl = order[b * global_bs:(b + 1) * global_bs]
             mine = sl[self.process_index::self.process_count]
             yield dataset.inputs[mine], dataset.targets[mine]
 
+    def _num_global_batches(self, n_windows: int) -> int:
+        """drop_last batch count: full global batches only (fixed XLA
+        shapes). THE single home of the windows->steps formula — iterate,
+        num_batches and get_total_steps_epoch must all agree or the cosine
+        schedule horizon diverges from the steps actually taken."""
+        return n_windows // (self.batch_size * self.process_count)
+
     def num_batches(self, dataset: PretrainDataset) -> int:
-        return len(dataset) // (self.batch_size * self.process_count)
+        return self._num_global_batches(len(dataset))
 
     def get_total_steps_epoch(self, files: List[str],
                               eos_text: str = "<|endoftext|>",
                               read_fn=None) -> int:
         """Count total optimizer steps per epoch across all corpus files.
 
-        Reference re-reads and re-tokenizes every file up front
-        (dataloader.py:87-103) to drive the cosine schedule; so do we,
-        including the trailing `` {eos_text} `` the trainer appends per file
-        (reference train.py:164-165).
+        The reference re-reads and re-tokenizes every file up front
+        (dataloader.py:87-103) to drive the cosine schedule; this pre-pass
+        now also WARMS the tokenize-once cache, so the training epochs that
+        follow reuse its encodings instead of paying them again.
         """
-        from building_llm_from_scratch_tpu.utils.io import read_text_file
-
-        read_fn = read_fn or read_text_file
         total = 0
         for path in files:
-            text = read_fn(path) + f" {eos_text} "
-            train, _val = self.create_datasets(text)
-            total += self.num_batches(train)
+            train_ids, _val_ids = self._file_token_ids(path, eos_text,
+                                                       read_fn)
+            n_windows = _num_windows(len(train_ids), self.max_length,
+                                     self.stride)
+            total += self._num_global_batches(n_windows)
         return total

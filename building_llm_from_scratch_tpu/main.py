@@ -135,7 +135,9 @@ def main(args) -> Trainer:
         loader = InstructLoader(pad_token_id=cfg.eos_id,
                                 dataset_name=args.dataset, **loader_kwargs)
     else:
-        loader = PretrainLoader(stride=cfg.context_length, **loader_kwargs)
+        loader = PretrainLoader(stride=cfg.context_length,
+                                token_cache_dir=args.tokenizer_cache_dir,
+                                **loader_kwargs)
 
     # 5. output dir (reference main.py:116-117)
     if is_coordinator():
@@ -180,6 +182,8 @@ def main(args) -> Trainer:
         log_every=args.log_every,
         stall=stall,
         compile_cache_dir=args.compile_cache_dir,
+        prefetch=args.prefetch,
+        async_ckpt=(args.async_ckpt == "on"),
     )
 
     # 7. train / finetune (reference main.py:150-157) under the graceful-

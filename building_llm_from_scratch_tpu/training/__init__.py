@@ -18,6 +18,9 @@ from building_llm_from_scratch_tpu.training.train_step import (
     make_sharded_train_step,
     make_train_step,
 )
+from building_llm_from_scratch_tpu.training.async_checkpoint import (
+    AsyncCheckpointer,
+)
 from building_llm_from_scratch_tpu.training.checkpoint import (
     export_params,
     load_checkpoint,
@@ -44,6 +47,7 @@ __all__ = [
     "PrecisionPolicy",
     "cast_floating",
     "get_policy",
+    "AsyncCheckpointer",
     "cross_entropy_loss",
     "cross_entropy_sums",
     "init_train_state",

@@ -1,0 +1,150 @@
+"""Non-blocking checkpoint writes: snapshot on the step loop, commit on a
+background thread.
+
+``save_checkpoint`` holds the step loop hostage for the full file-write
+dance — shard writes, sha256 hashing, manifest, two-rename commit — which
+at a realistic save cadence is pure device idle time. ``AsyncCheckpointer``
+splits the save at the only point that must be synchronous:
+
+  1. **Snapshot (main thread, blocking, cheap).** ``snapshot_for_save``
+     posts async D2H copies for every owned shard and materializes them as
+     host numpy — it blocks only until the in-flight donated steps finish
+     and the DMAs land. After this the checkpoint is decoupled from device
+     state: training may mutate (donate) the state freely.
+  2. **Write (background thread).** ``write_snapshot`` runs the identical
+     ``.tmp`` staging / sha256 manifest / two-rename commit sequence as the
+     synchronous save, so every PR-1 integrity consumer
+     (``validate_checkpoint``, ``--resume auto`` fallback, ``.tmp``/``.old``
+     recovery) works on its output unchanged.
+
+Contracts:
+
+  - **Serialized saves.** At most one write in flight: a new ``save()``
+    first ``wait()``s for the previous commit, so two saves can never
+    interleave their ``.tmp`` staging dirs (or race the ``.old`` dance on
+    the same tag).
+  - **Durability on demand.** ``wait()`` blocks until the last queued
+    checkpoint is committed; the trainer calls it at exit and on the
+    preemption path so the final checkpoint is always durable before the
+    process returns.
+  - **Failures surface.** A background write error is re-raised on the
+    main thread at the next ``save()``/``wait()`` — a run never trains for
+    hours believing checkpoints exist that don't.
+  - **Multi-host falls back to synchronous.** The sharded save's
+    correctness on pods rests on cross-host barriers (all shards on disk
+    before the manifest commits), and collectives are main-thread-only —
+    so with ``jax.process_count() > 1`` ``save()`` simply calls
+    ``save_checkpoint`` at the snapshot point, where they are legal. The
+    API is uniform either way; single-host runs (and each host of a
+    per-host-filesystem setup that opts out) get the overlap.
+
+Telemetry: each committed async save emits ``ckpt_async_save`` with
+``snapshot_s`` (what the step loop actually paid), ``write_s`` (the I/O
+that ran under training) and ``overlap_s`` (wall-clock the step loop kept
+training while the write proceeded — write start to commit).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+from building_llm_from_scratch_tpu.obs.metrics import emit_event
+from building_llm_from_scratch_tpu.training.checkpoint import (
+    save_checkpoint,
+    snapshot_for_save,
+    write_snapshot,
+)
+from building_llm_from_scratch_tpu.utils.logging import setup_logger
+
+logger = setup_logger(__name__)
+
+
+class AsyncCheckpointer:
+    """Background checkpoint writer; see module docstring."""
+
+    def __init__(self):
+        import jax
+
+        self._sync_fallback = jax.process_count() > 1
+        if self._sync_fallback:
+            logger.warning(
+                "AsyncCheckpointer: multi-host run — checkpoint writes "
+                "stay synchronous (the sharded save's cross-host barriers "
+                "are main-thread collectives).")
+        self._thread: Optional[threading.Thread] = None
+        self._exc: Optional[BaseException] = None
+        self.saves_started = 0
+        self.saves_committed = 0
+
+    # ------------------------------------------------------------------
+
+    @property
+    def in_flight(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def save(self, ckpt_dir: str, state: Dict[str, Any],
+             extra_metadata: Optional[dict] = None,
+             on_commit: Optional[Callable[[], None]] = None) -> None:
+        """Queue one checkpoint write. Blocks only for the previous save's
+        commit (serialization) and the host snapshot of ``state``.
+
+        ``on_commit`` runs AFTER the background commit succeeds (never on
+        failure) — for work that must only see durable checkpoints, e.g.
+        retention GC: pruning at queue time would count a checkpoint that
+        may never materialize. It runs on the writer thread, so it must be
+        collective-free (file ops only).
+        """
+        if self._sync_fallback:
+            save_checkpoint(ckpt_dir, state, extra_metadata=extra_metadata)
+            if on_commit is not None:
+                on_commit()
+            return
+        # at most one save in flight; also re-raises a previous failure
+        self.wait()
+        t0 = time.perf_counter()
+        snapshot = snapshot_for_save(state, extra_metadata=extra_metadata)
+        snapshot_s = time.perf_counter() - t0
+        step = (extra_metadata or {}).get("global_step")
+        self.saves_started += 1
+        t_resume = time.perf_counter()
+
+        def _write() -> None:
+            try:
+                t_w = time.perf_counter()
+                write_snapshot(ckpt_dir, snapshot)
+                now = time.perf_counter()
+                self.saves_committed += 1
+                emit_event("ckpt_async_save", path=ckpt_dir, step=step,
+                           snapshot_s=round(snapshot_s, 4),
+                           write_s=round(now - t_w, 4),
+                           overlap_s=round(now - t_resume, 4))
+                if on_commit is not None:
+                    on_commit()
+            except BaseException as e:      # noqa: BLE001 — re-raised at wait
+                self._exc = e
+
+        self._thread = threading.Thread(target=_write, daemon=True,
+                                        name="async-ckpt-writer")
+        self._thread.start()
+
+    def wait(self, reraise: bool = True) -> None:
+        """Block until the in-flight write (if any) committed. With
+        ``reraise`` (default) a background failure is raised HERE, on the
+        main thread; ``reraise=False`` logs it instead — for ``finally``
+        blocks that must not mask an already-propagating exception."""
+        t = self._thread
+        if t is not None:
+            t.join()
+            self._thread = None
+        if self._exc is not None:
+            exc, self._exc = self._exc, None
+            if reraise:
+                raise RuntimeError(
+                    "Async checkpoint write failed") from exc
+            logger.error("Async checkpoint write failed: %r", exc)
+
+    def close(self, reraise: bool = False) -> None:
+        """Trainer-exit hook: drain the writer (non-raising by default)."""
+        self.wait(reraise=reraise)

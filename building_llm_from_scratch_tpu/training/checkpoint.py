@@ -159,6 +159,61 @@ def _barrier(name: str) -> None:
         multihost_utils.sync_global_devices(name)
 
 
+def _plan_leaf_shards(index: int, leaf):
+    """Shard plan for one (``jnp_asarray``'d) leaf: the manifest shard
+    entries plus ``owned`` — the ``[(fname, device_buffer)]`` THIS process
+    is responsible for writing. One implementation shared by the
+    synchronous save loop and the async snapshot (``snapshot_for_save``),
+    so the two paths can never disagree about file layout or ownership."""
+    n_procs = jax.process_count()
+    local_ids = {d.id for d in jax.local_devices()}
+    shards_meta, owned = [], []
+    if n_procs > 1 and leaf.sharding.is_fully_addressable:
+        # host-local leaf (e.g. jnp.asarray of a python scalar before any
+        # jitted step): every process sees only its OWN devices in
+        # devices_indices_map, so each would elect a local owner for the
+        # same index and race np.save on the same file (round-4 ADVICE
+        # low #2). Route through process 0 alone.
+        fname = f"leaf_{index:05d}.shard_000.npy"
+        shards_meta.append({"file": fname,
+                            "index": [[0, d] for d in leaf.shape]})
+        if jax.process_index() == 0:
+            owned.append((fname, leaf))
+    else:
+        by_device = {s.device.id: s for s in leaf.addressable_shards}
+        for k, (owner, index_key) in enumerate(_unique_shards(leaf)):
+            fname = f"leaf_{index:05d}.shard_{k:03d}.npy"
+            shards_meta.append({"file": fname,
+                                "index": [list(se) for se in index_key]})
+            if owner.id in local_ids:
+                owned.append((fname, by_device[owner.id].data))
+    return shards_meta, owned
+
+
+def _plan_state_shards(state: Params):
+    """Flatten ``state`` into per-leaf shard plans and post every owned
+    shard's device->host copy asynchronously: ``np.asarray`` on each shard
+    otherwise serializes one transfer per leaf, and on a remote-tunnel
+    backend each blocking fetch pays full latency (r5: a save-every-100-
+    steps run measured ~10x slower than training). Only OWNER shards are
+    prefetched — replicas would multiply the transferred bytes by the
+    local device count for nothing. Returns
+    ``[(path, leaf, shards_meta, owned)]``."""
+    leaves = jax.tree_util.tree_flatten_with_path(state)[0]
+    planned = []
+    for i, (path, leaf) in enumerate(leaves):
+        leaf = jnp_asarray(leaf)
+        shards_meta, owned = _plan_leaf_shards(i, leaf)
+        planned.append((path, leaf, shards_meta, owned))
+    for _, _, _, owned in planned:
+        for _, buf in owned:
+            try:
+                buf.copy_to_host_async()
+            except (AttributeError, RuntimeError):
+                pass
+    return planned
+
+
 def save_checkpoint(ckpt_dir: str, state: Params,
                     extra_metadata: Optional[dict] = None) -> str:
     """Write ``state`` as a SHARDED checkpoint. Returns the dir.
@@ -182,7 +237,6 @@ def save_checkpoint(ckpt_dir: str, state: Params,
     """
     t_save = time.perf_counter()
     is_proc0 = jax.process_index() == 0
-    leaves = jax.tree_util.tree_flatten_with_path(state)[0]
     tmp_dir = ckpt_dir.rstrip("/") + ".tmp"
     if is_proc0:
         # a crashed earlier save may have left a stale staging dir
@@ -193,60 +247,16 @@ def save_checkpoint(ckpt_dir: str, state: Params,
         os.makedirs(tmp_dir, exist_ok=True)
     _barrier(f"ckpt_stage:{ckpt_dir}")
     os.makedirs(tmp_dir, exist_ok=True)
-    local_ids = {d.id for d in jax.local_devices()}
-    n_procs = jax.process_count()
     manifest = {"format": _SHARDED_FORMAT, "leaves": [],
                 "metadata": extra_metadata or {}}
-    # post every device->host copy asynchronously BEFORE the write loop:
-    # np.asarray on each shard otherwise serializes one transfer per leaf,
-    # and on a remote-tunnel backend each blocking fetch pays full latency
-    # (r5: a save-every-100-steps run measured ~10x slower than training).
-    # Only the OWNER shards the write loop will actually read are
-    # prefetched — replicas would multiply the transferred bytes by the
-    # local device count for nothing.
-    for _, leaf in leaves:
-        if not isinstance(leaf, jax.Array):
-            continue
-        if n_procs > 1 and leaf.sharding.is_fully_addressable:
-            owners = {min(d.id for d in leaf.sharding.device_set)} \
-                if is_proc0 else set()
-        else:
-            owners = {owner.id for owner, _ in _unique_shards(leaf)}
-        for s in leaf.addressable_shards:
-            if s.device.id in owners and s.device.id in local_ids:
-                try:
-                    s.data.copy_to_host_async()
-                except (AttributeError, RuntimeError):
-                    break
+    planned = _plan_state_shards(state)
     local_hashes: Dict[str, tuple] = {}      # fname -> (bytes, sha256)
-    for i, (path, leaf) in enumerate(leaves):
-        leaf = jnp_asarray(leaf)
-        shards_meta = []
-        if n_procs > 1 and leaf.sharding.is_fully_addressable:
-            # host-local leaf (e.g. jnp.asarray of a python scalar before
-            # any jitted step): every process sees only its OWN devices in
-            # devices_indices_map, so each would elect a local owner for
-            # the same index and race np.save on the same file (round-4
-            # ADVICE low #2). Route through process 0 alone.
-            fname = f"leaf_{i:05d}.shard_000.npy"
-            shards_meta.append({
-                "file": fname,
-                "index": [[0, d] for d in leaf.shape]})
+    for i, (path, leaf, shards_meta, owned) in enumerate(planned):
+        for fname, buf in owned:
+            nb, hx = _write_shard_hashed(os.path.join(tmp_dir, fname),
+                                         np.asarray(buf))
             if is_proc0:
-                local_hashes[fname] = _write_shard_hashed(
-                    os.path.join(tmp_dir, fname), np.asarray(leaf))
-        else:
-            by_device = {s.device.id: s for s in leaf.addressable_shards}
-            for k, (owner, index_key) in enumerate(_unique_shards(leaf)):
-                fname = f"leaf_{i:05d}.shard_{k:03d}.npy"
-                shards_meta.append({"file": fname,
-                                    "index": [list(se) for se in index_key]})
-                if owner.id in local_ids:
-                    nb, hx = _write_shard_hashed(
-                        os.path.join(tmp_dir, fname),
-                        np.asarray(by_device[owner.id].data))
-                    if is_proc0:
-                        local_hashes[fname] = (nb, hx)
+                local_hashes[fname] = (nb, hx)
         manifest["leaves"].append({
             "index": i,
             "path": _path_str(path),
@@ -297,6 +307,87 @@ def save_checkpoint(ckpt_dir: str, state: Params,
                step=(extra_metadata or {}).get("global_step"),
                seconds=round(time.perf_counter() - t_save, 4),
                bytes=total_bytes, leaves=len(manifest["leaves"]))
+    return ckpt_dir
+
+
+def snapshot_for_save(state: Params,
+                      extra_metadata: Optional[dict] = None) -> dict:
+    """Materialize everything ``write_snapshot`` needs to write a sharded
+    checkpoint WITHOUT touching device state again: the manifest skeleton
+    plus host copies of every owned shard.
+
+    This is the synchronous half of an async save (training/
+    async_checkpoint.py): it MUST run on the main thread — ``np.asarray``
+    below blocks until the in-flight donated steps that produce ``state``
+    have finished and the posted D2H DMAs land, which is device work the
+    background writer thread must never touch. Cost vs the streaming
+    synchronous save: the whole state is host-resident at once (that IS
+    the async tradeoff — the write, hash and commit I/O move off the
+    critical path in exchange for one state-sized host buffer).
+    """
+    planned = _plan_state_shards(state)
+    manifest = {"format": _SHARDED_FORMAT, "leaves": [],
+                "metadata": extra_metadata or {}}
+    arrays: Dict[str, np.ndarray] = {}
+    for i, (path, leaf, shards_meta, owned) in enumerate(planned):
+        for fname, buf in owned:
+            arrays[fname] = np.asarray(buf)
+        manifest["leaves"].append({
+            "index": i,
+            "path": _path_str(path),
+            "shape": list(leaf.shape),
+            "dtype": str(leaf.dtype),
+            "shards": shards_meta,
+        })
+    return {"manifest": manifest, "arrays": arrays}
+
+
+def write_snapshot(ckpt_dir: str, snapshot: dict) -> str:
+    """Write a ``snapshot_for_save`` snapshot as a committed checkpoint.
+
+    Pure host I/O over host arrays — safe on a background thread; the
+    same ``.tmp`` staging, sha256-manifest and two-rename commit sequence
+    as ``save_checkpoint``, so readers (``load_checkpoint``,
+    ``validate_checkpoint``, ``_resolve_ckpt_dir`` recovery) cannot tell
+    the two writers apart. Single-process writes only: the async path
+    falls back to the synchronous (barrier-using) save on multi-host runs
+    — ``AsyncCheckpointer`` enforces that, this function just refuses.
+    """
+    import shutil
+
+    if jax.process_count() > 1:
+        raise RuntimeError(
+            "write_snapshot is single-process only (its commit sequence "
+            "has no cross-host barriers); use save_checkpoint.")
+    t_save = time.perf_counter()
+    manifest, arrays = snapshot["manifest"], snapshot["arrays"]
+    tmp_dir = ckpt_dir.rstrip("/") + ".tmp"
+    if os.path.isdir(tmp_dir):
+        shutil.rmtree(tmp_dir)
+    os.makedirs(tmp_dir, exist_ok=True)
+    for leaf_meta in manifest["leaves"]:
+        for sh in leaf_meta["shards"]:
+            nb, hx = _write_shard_hashed(os.path.join(tmp_dir, sh["file"]),
+                                         arrays[sh["file"]])
+            sh["bytes"], sh["sha256"] = nb, hx
+    with open(os.path.join(tmp_dir, "manifest.json"), "w") as f:
+        json.dump(manifest, f, indent=1)
+    old_dir = None
+    if os.path.isdir(ckpt_dir):
+        old_dir = ckpt_dir.rstrip("/") + ".old"
+        if os.path.isdir(old_dir):
+            shutil.rmtree(old_dir)
+        os.rename(ckpt_dir, old_dir)
+    os.rename(tmp_dir, ckpt_dir)
+    if old_dir is not None:
+        shutil.rmtree(old_dir)
+    total_bytes = sum(int(sh.get("bytes", 0)) for leaf in manifest["leaves"]
+                      for sh in leaf["shards"])
+    emit_event("checkpoint_save", path=ckpt_dir,
+               step=manifest["metadata"].get("global_step"),
+               seconds=round(time.perf_counter() - t_save, 4),
+               bytes=total_bytes, leaves=len(manifest["leaves"]),
+               writer="async")
     return ckpt_dir
 
 

@@ -27,7 +27,15 @@ TPU-first differences:
     MFU/step-time/memory) land in the --metrics_jsonl sink at --log_every
     cadence, and an optional per-host stall detector gets one heartbeat
     per step-loop iteration. The deferred-fetch discipline is unchanged:
-    device scalars are still only fetched at cadence (_flush_metrics).
+    device scalars are still only fetched at cadence (_flush_metrics);
+  - host/device overlap (data/prefetch.py, training/async_checkpoint.py):
+    with prefetch>0 a bounded worker thread stages already-placed device
+    batches (H2D for batch k+1 under step k; exact FIFO order, so loss
+    trajectories are bit-identical and the data-cursor resume contract
+    holds), eval batches ride their own small prefetcher so the cadence
+    never drains the training queue, and with async_ckpt periodic saves
+    snapshot on the step loop but write/commit on a background thread
+    (exit-path saves still block until durable).
 """
 
 from __future__ import annotations
@@ -60,6 +68,10 @@ from building_llm_from_scratch_tpu.obs.health import (
     group_names as health_group_names,
     health_summary_line,
     nonfinite_group_name,
+)
+from building_llm_from_scratch_tpu.data.prefetch import Prefetcher
+from building_llm_from_scratch_tpu.training.async_checkpoint import (
+    AsyncCheckpointer,
 )
 from building_llm_from_scratch_tpu.training.checkpoint import (
     checkpoint_metadata,
@@ -123,7 +135,9 @@ class Trainer:
                  log_every: int = 0,
                  stall=None,
                  compile_cache_dir: Optional[str] = None,
-                 compile_telemetry: bool = True):
+                 compile_telemetry: bool = True,
+                 prefetch: int = 0,
+                 async_ckpt: bool = False):
         self.cfg = cfg
         self.tokenizer = tokenizer
         self.loader = loader
@@ -173,6 +187,19 @@ class Trainer:
         self._health_by_step: Dict[int, Any] = {}
         self._last_health = None
         self._ctx_health = None
+        # host/device overlap (data/prefetch.py + training/
+        # async_checkpoint.py): prefetch>0 runs the batch pipeline + H2D
+        # transfer on a bounded worker thread so data_wait collapses to
+        # queue-pop time; async_ckpt moves the checkpoint write/commit off
+        # the step loop (snapshot stays synchronous — see the module)
+        self.prefetch = prefetch
+        self._async_ckpt = AsyncCheckpointer() if async_ckpt else None
+        self._pf_base = {"stalls": 0, "pops": 0, "fill_sum": 0}
+        # run-level overlap accounting (bench.py --prefetch A/B reads
+        # these): cadence-window sums of data-pipeline wait vs step time
+        self.data_wait_total_s = 0.0
+        self.step_seconds_total = 0.0
+        self.prefetch_stall_total = 0
         self.timeline = StepTimeline()
         # (epoch, file_index, batch_index) of the NEXT batch to train —
         # written into checkpoint metadata so resume fast-forwards the
@@ -390,6 +417,39 @@ class Trainer:
             return self.plan.shard_batch(batch)
         return batch
 
+    def _staged_batch(self, arrays: Sequence[np.ndarray]) -> Dict[str, Any]:
+        """Prefetcher placement hook: the sharded transfer (plan.shard_batch
+        / make_array_from_process_local_data), or a plain device_put when
+        no mesh plan exists — either way the queue holds device-resident
+        batches, so the H2D DMA for batch k+1 overlaps step k instead of
+        hiding inside jit dispatch."""
+        batch = self._device_batch(arrays)
+        if self.plan is None:
+            batch = jax.device_put(batch)
+        return batch
+
+    def _staged_item(self, arrays: Sequence[np.ndarray]):
+        """What the prefetch queue holds: (placed batch, per-process token
+        count). The count comes from the HOST arrays — after plan.shard_batch
+        the device array's leading dim is the GLOBAL batch, and tokens_seen
+        has always counted this process's share."""
+        return (self._staged_batch(arrays),
+                int(np.prod(np.shape(arrays[0]))))
+
+    def _place_in_worker(self) -> bool:
+        """Whether the prefetch worker thread may perform device placement
+        itself. True on real accelerators and single-device runs; False for
+        multi-device placement on the forced-host-platform CPU backend —
+        that is the collective-rendezvous surface that CHECK-aborts under
+        thread contention (see the round-4 note in ``_flush_metrics``), so
+        there the queue stays host-side and placement happens at pop."""
+        return self.plan is None or jax.default_backend() != "cpu"
+
+    def _batch_prefetcher(self, batches, *, depth: int,
+                          name: str) -> Prefetcher:
+        return Prefetcher(batches, depth, place_fn=self._staged_item,
+                          place_in_worker=self._place_in_worker(), name=name)
+
     # ------------------------------------------------------------------
     # Evaluation / sampling (reference train.py:213-276)
     # ------------------------------------------------------------------
@@ -397,6 +457,23 @@ class Trainer:
     def calc_loss_loader(self, batches, num_batches: Optional[int] = None
                          ) -> float:
         losses = []
+        if self.prefetch > 0:
+            # pre-stage eval batches through a SECOND small prefetcher:
+            # eval gets its own queue + iterator, so the cadence never
+            # drains or disorders the training prefetcher's queue (which
+            # keeps refilling underneath while eval runs)
+            import itertools
+
+            if num_batches is not None:
+                batches = itertools.islice(batches, num_batches)
+            pf = self._batch_prefetcher(batches, depth=min(self.prefetch, 2),
+                                        name="eval-prefetch")
+            try:
+                for batch, _n_tok in pf:
+                    losses.append(float(self.eval_step(self.state, batch)))
+            finally:
+                pf.close()
+            return float(np.mean(losses)) if losses else float("nan")
         for i, arrays in enumerate(batches):
             if num_batches is not None and i >= num_batches:
                 break
@@ -433,7 +510,8 @@ class Trainer:
     # ------------------------------------------------------------------
 
     def save_checkpoint(self, tag: str,
-                        cursor: Optional[Dict[str, int]] = None) -> str:
+                        cursor: Optional[Dict[str, int]] = None,
+                        prune_after: bool = False) -> str:
         path = os.path.join(self.output_dir, f"model_pg_{tag}")
         metadata = {
             "global_step": self.global_step,
@@ -445,8 +523,27 @@ class Trainer:
         }
         if cursor is not None:
             metadata["cursor"] = cursor
-        save_checkpoint(path, self.state, extra_metadata=metadata)
-        logger.info("Saved checkpoint %s", path)
+        if self._async_ckpt is not None:
+            # retention GC rides the commit callback: pruning here, at
+            # queue time, would delete old recovery points on the strength
+            # of a checkpoint that is not yet (and may never be) durable
+            self._async_ckpt.save(
+                path, self.state, extra_metadata=metadata,
+                on_commit=(self._prune_old_checkpoints if prune_after
+                           else None))
+            if tag in ("interrupted", "final"):
+                # exit-path checkpoints must be DURABLE before the caller
+                # proceeds (the preemption grace window, the final export)
+                self._async_ckpt.wait()
+                logger.info("Saved checkpoint %s", path)
+            else:
+                logger.info("Queued async checkpoint %s "
+                            "(write overlaps training)", path)
+        else:
+            save_checkpoint(path, self.state, extra_metadata=metadata)
+            logger.info("Saved checkpoint %s", path)
+            if prune_after:
+                self._prune_old_checkpoints()
         return path
 
     def _prune_old_checkpoints(self) -> None:
@@ -526,24 +623,63 @@ class Trainer:
             batches = itertools.islice(batches, skip_batches, None)
             if n_batches is not None:
                 n_batches = max(0, n_batches - skip_batches)
+        # host/device overlap: wrap the (already fast-forwarded) iterator
+        # in the bounded background prefetcher — the resume skip above ran
+        # BEFORE the queue exists, so it only ever stages batches that
+        # will train, and exact FIFO order keeps the data-cursor contract.
+        # tqdm wraps the prefetcher (not the source) so progress counts
+        # batches CONSUMED, not batches staged.
+        prefetcher = None
+        stream = batches
+        if self.prefetch > 0:
+            prefetcher = self._batch_prefetcher(stream, depth=self.prefetch,
+                                                name="train-prefetch")
+            self._pf_base = prefetcher.counters()
+            stream = prefetcher
         if self.show_progress and jax.process_index() == 0:
             # per-file batch progress (reference train.py:159,188 wraps the
             # loader in tqdm); leave=False keeps the log uncluttered
             from tqdm import tqdm
 
-            batches = tqdm(batches, total=n_batches, desc=desc,
-                           unit="batch", leave=False)
+            stream = tqdm(stream, total=n_batches, desc=desc,
+                          unit="batch", leave=False)
         batch_in_file = skip_batches
-        batches_iter = iter(batches)
+        batches_iter = iter(stream)
+        try:
+            self._epoch_steps(batches_iter, prefetcher, train_batches_fn,
+                              val_batches_fn, epoch, file_index, file_name,
+                              batch_in_file, start_context, t_tokens,
+                              t_start, log_cadence)
+        finally:
+            # the worker must die on EVERY exit: normal exhaustion,
+            # PreemptionStop, watchdog halt, or any exception unwinding
+            if prefetcher is not None:
+                self.prefetch_stall_total += prefetcher.stalls
+                prefetcher.close()
+
+    def _epoch_steps(self, batches_iter, prefetcher, train_batches_fn,
+                     val_batches_fn, epoch: int, file_index: int,
+                     file_name: str, batch_in_file: int, start_context: str,
+                     t_tokens: int, t_start: float, log_cadence: int):
+        """The per-batch step loop of ``_run_epoch`` (split out so the
+        prefetcher teardown wraps it in one ``finally``)."""
         while True:
             # explicit next() so the wait on the data pipeline is its own
             # timeline segment (and trace span) instead of vanishing into
-            # the loop header
+            # the loop header. With the prefetcher this measures QUEUE-POP
+            # time (near zero in steady state); genuine host starvation
+            # shows up in the prefetch_stall counter instead.
             with self.timeline.span("data_wait"):
-                arrays = next(batches_iter, None)
-            if arrays is None:
+                item = next(batches_iter, None)
+            if item is None:
                 break
-            batch = self._device_batch(arrays)
+            # the prefetcher already placed the batch (its worker ran
+            # _staged_item); the synchronous path places here
+            if prefetcher is not None:
+                batch, n_tok = item
+            else:
+                batch = self._device_batch(item)
+                n_tok = int(np.prod(item[0].shape))
             with self.timeline.step_span(self.global_step + 1):
                 self.state, metrics = self.train_step(self.state, batch)
             self.global_step += 1
@@ -551,7 +687,6 @@ class Trainer:
             self._cursor = {"epoch": epoch, "file_index": file_index,
                             "file": file_name,
                             "batch_index": batch_in_file}
-            n_tok = int(np.prod(arrays[0].shape))
             self.tokens_seen += n_tok
             t_tokens += n_tok
             # keep the device scalar; float() here would block the host on
@@ -605,6 +740,8 @@ class Trainer:
                 stats = window_stats(window, elapsed, t_tokens)
                 tps = stats["tok_s"]
                 self.throughput_tokens_per_s.append(tps)
+                self.data_wait_total_s += window.get("data_wait", 0.0)
+                self.step_seconds_total += stats["step_seconds"] or 0.0
                 # the window reopens HERE: the eval below (and any
                 # sample/checkpoint cadence after it) runs inside the new
                 # window but lands in excluded timeline segments, so the
@@ -632,6 +769,21 @@ class Trainer:
                     "host_fetch_s": round(window.get("host_fetch", 0.0), 6),
                     "steps_in_window": int(window.get("steps", 0)),
                 }
+                stall_delta = 0
+                if prefetcher is not None:
+                    # prefetch telemetry, as window deltas: stalls (pops
+                    # that found the queue empty — the host can't keep
+                    # up), mean fill ratio, and the instantaneous depth
+                    c = prefetcher.counters()
+                    stall_delta = c["stalls"] - self._pf_base["stalls"]
+                    pops = c["pops"] - self._pf_base["pops"]
+                    fill = c["fill_sum"] - self._pf_base["fill_sum"]
+                    self._pf_base = c
+                    row["prefetch_stall"] = stall_delta
+                    row["prefetch_qdepth"] = prefetcher.qsize()
+                    if pops > 0:
+                        row["prefetch_fill_ratio"] = round(
+                            fill / pops / prefetcher.depth, 3)
                 if mfu_hlo is not None:
                     row["mfu_hlo"] = mfu_hlo
                     if mfu is not None:
@@ -672,11 +824,13 @@ class Trainer:
                 else:
                     logger.info(
                         "step %d: lr %.2e, %.0f tok/s, %s, "
-                        "step %.1fms (data_wait %.1fms)",
+                        "step %.1fms (data_wait %.1fms%s)",
                         self.global_step, self.track_lrs[-1], tps,
                         format_mfu(mfu),
                         1e3 * (stats["step_time_s"] or 0.0),
-                        1e3 * window.get("data_wait", 0.0))
+                        1e3 * window.get("data_wait", 0.0),
+                        f", {stall_delta} prefetch stalls"
+                        if prefetcher is not None else "")
                 self.metrics_sink.log_metrics(self.global_step, **row)
                 self._emit_health_row()
 
@@ -687,8 +841,8 @@ class Trainer:
             if self.global_step % self.save_ckpt_freq == 0:
                 with self.timeline.span("checkpoint"):
                     self.save_checkpoint(str(self.global_step),
-                                         cursor=self._cursor)
-                    self._prune_old_checkpoints()
+                                         cursor=self._cursor,
+                                         prune_after=True)
 
             if self.stopper is not None and self.stopper.should_stop():
                 # preemption-safe stop at the step boundary: the signal was
@@ -793,8 +947,16 @@ class Trainer:
                                                         path)
                     if skip_file:
                         continue
-                    text = read_text_file(path) + f" {self.cfg.eos_text} "
-                    train_ds, val_ds = self.loader.create_datasets(text)
+                    if hasattr(self.loader, "create_datasets_for_file"):
+                        # tokenize-once path: the total-steps pre-pass
+                        # above already warmed the per-file token cache,
+                        # so this (and every later epoch) is a cache hit —
+                        # no re-read, no re-encode (data/pretrain.py)
+                        train_ds, val_ds = self.loader.create_datasets_for_file(
+                            path, eos_text=self.cfg.eos_text)
+                    else:
+                        text = read_text_file(path) + f" {self.cfg.eos_text} "
+                        train_ds, val_ds = self.loader.create_datasets(text)
                     if self.loader.num_batches(train_ds) == 0:
                         logger.warning("File %s too small for one batch; "
                                        "skipping", path)
@@ -828,6 +990,12 @@ class Trainer:
             # no watchdog here: raising out of finally would mask an
             # in-flight exception from the try body
             self._flush_metrics(check_watchdog=False)
+            if self._async_ckpt is not None:
+                # drain the background writer before returning — and
+                # non-raising, so a write failure here can't mask an
+                # in-flight exception (exit-path saves already waited
+                # with reraise inside save_checkpoint)
+                self._async_ckpt.close()
         return self
 
     def finetune_model(self, files: Sequence[str], n_epochs: int):
@@ -884,6 +1052,8 @@ class Trainer:
         finally:
             self._stop_profiler()
             self._flush_metrics(check_watchdog=False)
+            if self._async_ckpt is not None:
+                self._async_ckpt.close()
         return self
 
     def export_final(self, filename: str = "model_pg_final.npz") -> str:

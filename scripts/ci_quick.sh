@@ -38,6 +38,36 @@ echo "$render_out" | grep -q "per-layer-group grad norms" || exit 1
 echo "$render_out" | grep -q "compile telemetry" || exit 1
 echo "renderer ok"
 
+echo "== host-overlap smoke (prefetch + async checkpoint, CPU) =="
+JAX_PLATFORMS=cpu python - <<'EOF' || exit 1
+import json, os, tempfile
+d = tempfile.mkdtemp()
+data = os.path.join(d, "data"); os.makedirs(data)
+# tiny corpus: a couple of debug-context batches — just enough steps for
+# one async periodic save to commit while training continues
+open(os.path.join(data, "corpus.txt"), "w").write("tiny smoke corpus. " * 160)
+out = os.path.join(d, "out")
+from building_llm_from_scratch_tpu.args import get_args
+from building_llm_from_scratch_tpu.main import main
+trainer = main(get_args([
+    "--data_dir", data, "--output_dir", out, "--debug", "--byte_tokenizer",
+    "--n_epochs", "1", "--batch_size", "4", "--eval_freq", "1000",
+    "--log_every", "1", "--print_sample_iter", "100000",
+    "--save_ckpt_freq", "1", "--warmup_steps", "1",
+    "--prefetch", "2", "--async_ckpt", "on",
+    "--metrics_jsonl", os.path.join(out, "metrics.jsonl"),
+]))
+assert trainer.global_step >= 2, trainer.global_step
+rows = [json.loads(l) for l in open(os.path.join(out, "metrics.jsonl"))]
+async_saves = [r for r in rows if r.get("event") == "ckpt_async_save"]
+assert async_saves, "no ckpt_async_save event in the JSONL"
+stalls = sum(r.get("prefetch_stall", 0) for r in rows
+             if r.get("type") == "metrics")
+assert stalls == 0, f"prefetch stalled {stalls}x on the smoke workload"
+print(f"overlap smoke ok: {trainer.global_step} steps, "
+      f"{len(async_saves)} async saves, 0 prefetch stalls")
+EOF
+
 echo "== tier-1 tests (ROADMAP.md) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

@@ -90,6 +90,40 @@ def summarize(header, metrics, events):
         secs = [e["seconds"] for e in ckpt]
         print(f"checkpoints: {len(ckpt)} saves, "
               f"mean {sum(secs) / len(secs):.2f}s, max {max(secs):.2f}s")
+    summarize_overlap(metrics, events)
+
+
+def summarize_overlap(metrics, events):
+    """Host-overlap section: data_wait share of step time, prefetch
+    stalls/fill (an underpowered host shows up HERE once prefetching makes
+    data_wait itself near-zero), and async-checkpoint overlap seconds."""
+    _, waits = column(metrics, "data_wait_s")
+    steps_w = [r.get("steps_in_window") for r in metrics
+               if isinstance(r.get("data_wait_s"), (int, float))]
+    if waits:
+        n_steps = sum(s for s in steps_w if isinstance(s, (int, float)))
+        per_step = sum(waits) / max(n_steps, 1)
+        print(f"data_wait: {1e3 * per_step:.2f} ms/step "
+              f"({sum(waits):.2f}s total)")
+    stalls = [r["prefetch_stall"] for r in metrics
+              if isinstance(r.get("prefetch_stall"), (int, float))]
+    if stalls:
+        _, fills = column(metrics, "prefetch_fill_ratio")
+        total = int(sum(stalls))
+        fill_txt = (f", mean fill {sum(fills) / len(fills):.2f}"
+                    if fills else "")
+        print(f"prefetch: {total} stalls{fill_txt}"
+              + ("" if total == 0 else
+                 " — the HOST is the bottleneck (queue empty at pop): "
+                 "raise --prefetch depth, or speed up the data pipeline"))
+    async_saves = [e for e in events if e["event"] == "ckpt_async_save"
+                   and isinstance(e.get("overlap_s"), (int, float))]
+    if async_saves:
+        ov = [e["overlap_s"] for e in async_saves]
+        snap = [e.get("snapshot_s", 0) for e in async_saves]
+        print(f"async checkpoints: {len(async_saves)} saves, "
+              f"{sum(ov):.2f}s of write overlapped training "
+              f"(step loop paid only {sum(snap):.2f}s of snapshots)")
 
 
 def _fmt_bytes(n):

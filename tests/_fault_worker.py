@@ -30,6 +30,13 @@ def main():
         "--batch_size", "4", "--eval_freq", "10",
         "--print_sample_iter", "100000", "--save_ckpt_freq", "5",
         "--warmup_steps", "2", "--keep_ckpts", "2",
+        # host-overlap round: the killed run exercises the FULL overlap
+        # stack — batch prefetching and async checkpoint writes — so the
+        # SIGTERM lands while a prefetch worker is staging batches and
+        # periodic saves are committing on a background thread. The
+        # graceful stop must still tear both down cleanly and leave a
+        # durable interrupted checkpoint.
+        "--prefetch", "2", "--async_ckpt", "on",
         # structured telemetry: the parent test asserts the preemption +
         # checkpoint events landed in the sink (rows flush per write, so
         # the file is complete even though this process gets SIGTERMed)

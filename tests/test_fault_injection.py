@@ -30,13 +30,19 @@ WORKER = os.path.join(REPO, "tests", "_fault_worker.py")
 TEXT = "Every effort moves you closer to mastery. " * 300
 
 
-def _args(data_dir, out_dir):
+def _args(data_dir, out_dir, overlap=False):
+    """``overlap=True`` turns on the host-overlap stack (batch prefetch +
+    async checkpoint writes); the uninterrupted reference runs the strict
+    synchronous path, so the bit-for-bit comparison at the bottom also
+    proves the overlap machinery changes NOTHING about training."""
+    extra = (["--prefetch", "2", "--async_ckpt", "on"] if overlap
+             else ["--prefetch", "0"])
     return get_args([
         "--data_dir", data_dir, "--output_dir", out_dir,
         "--debug", "--byte_tokenizer", "--n_epochs", "1",
         "--batch_size", "4", "--eval_freq", "10",
         "--print_sample_iter", "100000", "--save_ckpt_freq", "5",
-        "--warmup_steps", "2", "--keep_ckpts", "2",
+        "--warmup_steps", "2", "--keep_ckpts", "2", *extra,
     ])
 
 
@@ -109,8 +115,10 @@ def test_sigterm_preemption_then_auto_resume_matches_uninterrupted(tmp_path):
 
     # 3. relaunch with the SAME command: --resume auto (the default) must
     #    discover the interrupted checkpoint, fast-forward the data cursor,
-    #    and finish the epoch
-    resumed = main(_args(str(data_dir), out_kill))
+    #    and finish the epoch — WITH the overlap stack on (prefetch + async
+    #    saves, matching the killed worker's flags), against the
+    #    synchronous reference
+    resumed = main(_args(str(data_dir), out_kill, overlap=True))
     assert not resumed.preempted
     assert resumed.global_step == ref.global_step
     assert resumed.tokens_seen == ref.tokens_seen
