@@ -59,9 +59,45 @@ def perform_checks(args) -> None:
     if not args.warnings:
         warnings.filterwarnings("ignore")
 
-    if not os.path.exists(args.data_dir):
+    # serve mode decodes, it never reads the training corpus — the data
+    # dir requirement only applies to the training modes
+    if args.mode != "serve" and not os.path.exists(args.data_dir):
         raise FileNotFoundError(
             f"Data directory '{args.data_dir}' does not exist.")
+
+    if args.mode == "serve":
+        if not (args.serve_prompts or args.serve_port):
+            raise ValueError(
+                "--mode serve needs a workload: --serve_prompts "
+                "<requests.jsonl> and/or --serve_port <port>.")
+        if args.serve_prompts and not os.path.isfile(args.serve_prompts):
+            raise FileNotFoundError(
+                f"--serve_prompts '{args.serve_prompts}' does not exist.")
+        if args.serve_slots < 1:
+            raise ValueError("--serve_slots must be >= 1.")
+        if args.serve_max_queue < 1:
+            raise ValueError("--serve_max_queue must be >= 1.")
+        if args.serve_max_new_tokens < 1:
+            raise ValueError("--serve_max_new_tokens must be >= 1.")
+        if args.serve_max_top_k < 1:
+            raise ValueError("--serve_max_top_k must be >= 1.")
+        if args.serve_max_len < 0:
+            raise ValueError("--serve_max_len must be >= 0 (0 = model "
+                             "context length).")
+    else:
+        # every serve flag, not just the workload pair: a non-default
+        # value outside serve mode is a mistyped/missing --mode serve,
+        # not a flag to silently drop
+        stray = [f"--{name}" for name, default in (
+            ("serve_prompts", None), ("serve_port", 0),
+            ("serve_out", None), ("serve_slots", 8),
+            ("serve_max_queue", 64), ("serve_max_new_tokens", 128),
+            ("serve_max_len", 0), ("serve_max_top_k", 64),
+            ("serve_host", "127.0.0.1"),
+        ) if getattr(args, name) != default]
+        if stray:
+            raise ValueError(
+                f"{', '.join(stray)} require --mode serve.")
 
     if args.num_params not in MODEL_PARAMS_MAPPING.get(args.model, []):
         raise ValueError(
@@ -222,11 +258,61 @@ def get_args(argv=None):
         prog="building_llm_from_scratch_tpu",
         description="TPU-native Large Language Model Training Configuration")
 
+    # Run mode
+    parser.add_argument("--mode", type=str, default="train",
+                        choices=["train", "serve"],
+                        help="'train' (default): the pretrain/finetune "
+                             "pipeline. 'serve': the continuous-batching "
+                             "decode engine (serving/) — load or init the "
+                             "model per the usual model flags, then serve "
+                             "--serve_prompts JSONL and/or an HTTP "
+                             "endpoint on --serve_port.")
+
     # Dataset and I/O paths
     parser.add_argument("--data_dir", type=str, default="data",
                         help="Path to the dataset directory.")
     parser.add_argument("--output_dir", type=str, default="model_checkpoints",
                         help="Directory to save model checkpoints.")
+
+    # Serving (--mode serve; serving/ package)
+    parser.add_argument("--serve_slots", type=int, default=8,
+                        help="Decode slots: the fixed batch rows the "
+                             "engine keeps full (one XLA decode program "
+                             "regardless of traffic).")
+    parser.add_argument("--serve_max_queue", type=int, default=64,
+                        help="Bounded request queue capacity; submissions "
+                             "beyond it are rejected (HTTP 429) — "
+                             "backpressure instead of unbounded memory.")
+    parser.add_argument("--serve_port", type=int, default=0,
+                        help="Serve a minimal stdlib HTTP endpoint on this "
+                             "port (POST /generate, GET /healthz). "
+                             "0 disables.")
+    parser.add_argument("--serve_host", type=str, default="127.0.0.1",
+                        help="Bind address for --serve_port. Loopback by "
+                             "default — the endpoint is unauthenticated; "
+                             "pass 0.0.0.0 to expose it deliberately.")
+    parser.add_argument("--serve_prompts", type=str, default=None,
+                        help="JSONL request file: one {'prompt': ..., "
+                             "'max_new_tokens': ..., 'temperature': ..., "
+                             "'top_k': ..., 'seed': ...} per line; "
+                             "results are written as JSONL to "
+                             "--serve_out (default stdout).")
+    parser.add_argument("--serve_out", type=str, default=None,
+                        help="Path for the JSONL results of "
+                             "--serve_prompts (default stdout).")
+    parser.add_argument("--serve_max_new_tokens", type=int, default=128,
+                        help="Default per-request token budget when a "
+                             "request does not specify max_new_tokens.")
+    parser.add_argument("--serve_max_top_k", type=int, default=64,
+                        help="Largest per-request top_k the compiled "
+                             "decode program supports (static top-k "
+                             "capacity); requests above it are rejected "
+                             "with a 400.")
+    parser.add_argument("--serve_max_len", type=int, default=0,
+                        help="Per-slot KV capacity (prompt + generated); "
+                             "0 (default) uses the model context length. "
+                             "Smaller values cut the cache footprint "
+                             "when serving short sequences.")
 
     # Training configuration
     parser.add_argument("--n_epochs", type=int, default=2,

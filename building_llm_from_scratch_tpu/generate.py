@@ -50,6 +50,49 @@ def _sample_token(logits: jnp.ndarray, rng: jax.Array, temperature: float,
     return jnp.argmax(logits, axis=-1)
 
 
+def token_rng(rng: jax.Array, i) -> jax.Array:
+    """Per-token sampling key: ``fold_in(rng, i)`` where ``i`` is the
+    number of tokens generated so far. ONE derivation shared by
+    ``generate()`` and the serving engine — a request sampled with seed s
+    draws the identical key sequence whether it runs through the one-shot
+    path or any slot of a continuous batch (serving/engine.py)."""
+    return jax.random.fold_in(rng, i)
+
+
+def sample_tokens_dynamic(logits: jnp.ndarray, keys: jnp.ndarray,
+                          temperature: jnp.ndarray, top_k: jnp.ndarray,
+                          max_top_k: int) -> jnp.ndarray:
+    """Per-row sampling with DYNAMIC per-row params — the serving engine's
+    slot batch mixes requests with different temperature/top_k/seed in one
+    compiled program.
+
+    logits (S, V); keys (S,) PRNG keys (stacked key data); temperature
+    (S,) fp32 (0 = greedy argmax); top_k (S,) int32 (0 = disabled, else
+    1..max_top_k — ``max_top_k`` is the STATIC top-k capacity the program
+    is compiled for).
+
+    Row-wise equivalent of ``_sample_token``: the k-th-largest threshold,
+    the -inf filter and the categorical draw match it exactly (same key,
+    same logits => same token), which is what the engine-vs-generate()
+    parity test pins down.
+    """
+    vals = jax.lax.top_k(logits, max_top_k)[0]            # (S, K) desc
+    idx = jnp.clip(top_k, 1, max_top_k) - 1
+    kth = jnp.take_along_axis(vals, idx[:, None], axis=1)  # (S, 1)
+    filtered = jnp.where(logits < kth, -jnp.inf, logits)
+    logits = jnp.where((top_k > 0)[:, None], filtered, logits)
+
+    def one(key, row, t):
+        greedy = jnp.argmax(row)
+        scaled = row / jnp.where(t > 0.0, t, 1.0)
+        # (1, V) shape so the draw matches _sample_token's batched
+        # categorical bit-for-bit for a single-row batch
+        sampled = jax.random.categorical(key, scaled[None, :], axis=-1)[0]
+        return jnp.where(t > 0.0, sampled, greedy)
+
+    return jax.vmap(one)(keys, logits, temperature)
+
+
 def _bucket(n: int, step: int = 64, lo: int = 32) -> int:
     """Round up to the compile-shape bucket (multiples of ``step``, floor
     ``lo``) so nearby prompt/budget lengths share one XLA program."""
@@ -58,12 +101,13 @@ def _bucket(n: int, step: int = 64, lo: int = 32) -> int:
 
 @functools.partial(
     jax.jit,
-    static_argnames=("cfg", "budget", "temperature", "top_k", "eos_id"))
+    static_argnames=("cfg", "budget", "temperature", "top_k", "eos_id",
+                     "ref_eos"))
 def _generate_cached(params, cfg: ModelConfig, prompt: jnp.ndarray,
                      prompt_len: jnp.ndarray, rng: jax.Array,
                      max_new_tokens: jnp.ndarray, budget: int,
                      temperature: float, top_k: Optional[int],
-                     eos_id: Optional[int]):
+                     eos_id: Optional[int], ref_eos: bool):
     """KV-cache decode over BUCKETED shapes.
 
     ``prompt`` is right-padded to its length bucket; ``prompt_len`` (traced)
@@ -75,8 +119,19 @@ def _generate_cached(params, cfg: ModelConfig, prompt: jnp.ndarray,
     by one, and attention masks everything past ``length`` (kv_length)
     until they do.
 
-    Returns (tokens (B, Tpb + budget), n_generated): entries
-    [:prompt_len + n_generated] are prompt + generated (generated tokens
+    eos handling: by default each ROW tracks its own finished state — a
+    row that samples eos stops (the eos token itself is dropped, matching
+    the reference's drop-the-trigger quirk per row) while the others keep
+    decoding; finished rows' later columns are padded with ``eos_id``.
+    ``ref_eos=True`` restores the reference's batch-global quirk exactly
+    (stop only when ALL rows sample eos in the SAME step, generate.py:68-73)
+    for bit-parity tests.
+
+    Token i is sampled with ``token_rng(rng, i)`` — the derivation the
+    serving engine shares, so seeded requests reproduce across both paths.
+
+    Returns (tokens (B, Tpb + budget), n_generated (B,)): row b's entries
+    [:prompt_len + n_generated[b]] are prompt + generated (generated tokens
     are written AT prompt_len, overwriting pad slots first).
     """
     B, Tpb = prompt.shape
@@ -99,43 +154,66 @@ def _generate_cached(params, cfg: ModelConfig, prompt: jnp.ndarray,
         [prompt, jnp.zeros((B, budget), prompt.dtype)], axis=1)
 
     def cond(carry):
-        _buf, _cache, _last_logits, _rng, i, done = carry
-        return (i < max_new_tokens) & ~done
+        _buf, _cache, _last_logits, i, done, _n = carry
+        return (i < max_new_tokens) & ~jnp.all(done)
 
     def body(carry):
-        buf, cache, last_logits, rng, i, done = carry
-        rng, sub = jax.random.split(rng)
+        buf, cache, last_logits, i, done, n_gen = carry
+        sub = token_rng(rng, i)
         nxt = _sample_token(last_logits, sub, temperature, top_k)  # (B,)
-        if eos_id is not None:
-            all_eos = jnp.all(nxt == eos_id)
+        hit = (nxt == eos_id) if eos_id is not None \
+            else jnp.zeros((B,), bool)
+        if ref_eos:
+            # reference quirk: the token that makes ALL rows hit eos is
+            # dropped and the loop stops (generate.py:68-73)
+            all_eos = jnp.all(hit) if eos_id is not None \
+                else jnp.asarray(False)
+            buf = jax.lax.cond(
+                all_eos, lambda b: b,
+                lambda b: jax.lax.dynamic_update_slice(b, nxt[:, None].astype(
+                    b.dtype), (0, prompt_len + i)),
+                buf)
+            done = jnp.broadcast_to(all_eos, done.shape)
+            n_gen = jnp.where(all_eos, i, i + 1) * jnp.ones_like(n_gen)
         else:
-            all_eos = jnp.asarray(False)
-        # reference quirk: the token that makes ALL rows hit eos is dropped
-        # and the loop stops (generate.py:68-73)
-        buf = jax.lax.cond(
-            all_eos, lambda b: b,
-            lambda b: jax.lax.dynamic_update_slice(b, nxt[:, None].astype(
-                b.dtype), (0, prompt_len + i)),
-            buf)
+            newly = ~done & hit               # this row's eos: drop + stop
+            alive = ~done & ~newly
+            pad = jnp.asarray(eos_id if eos_id is not None else 0,
+                              buf.dtype)
+            col = jnp.where(alive, nxt.astype(buf.dtype), pad)
+            buf = jax.lax.dynamic_update_slice(buf, col[:, None],
+                                               (0, prompt_len + i))
+            done = done | newly
+            n_gen = n_gen + alive.astype(n_gen.dtype)
         new_logits, cache = forward_with_cache(
             params, cfg, nxt[:, None].astype(jnp.int32), cache, blocks_list)
-        return (buf, cache, new_logits[:, -1], rng, i + 1, all_eos)
+        return (buf, cache, new_logits[:, -1], i + 1, done, n_gen)
 
-    carry = (buf, cache, last, rng, jnp.zeros((), jnp.int32),
-             jnp.asarray(False))
-    buf, _cache, _logits, _rng, i, done = jax.lax.while_loop(cond, body, carry)
-    n_generated = jnp.where(done, i - 1, i)
-    return buf, n_generated
+    carry = (buf, cache, last, jnp.zeros((), jnp.int32),
+             jnp.zeros((B,), bool), jnp.zeros((B,), jnp.int32))
+    buf, _cache, _logits, _i, _done, n_gen = jax.lax.while_loop(
+        cond, body, carry)
+    return buf, n_gen
 
 
 def generate(params, cfg: ModelConfig, token_ids, max_new_tokens: int,
              context_size: Optional[int] = None, temperature: float = 0.0,
              top_k: Optional[int] = None, eos_id: Optional[int] = None,
-             rng: Optional[jax.Array] = None) -> np.ndarray:
+             rng: Optional[jax.Array] = None,
+             ref_eos_semantics: bool = False,
+             return_n_generated: bool = False) -> np.ndarray:
     """Generate up to ``max_new_tokens`` after ``token_ids`` (B, Tp).
 
-    Returns a numpy (B, Tp + n_generated) array, mirroring the reference's
-    return of prompt+generated ids (generate.py:73-75).
+    Returns a numpy (B, Tp + max_row_generated) array, mirroring the
+    reference's return of prompt+generated ids (generate.py:73-75).
+
+    eos semantics: each row stops at ITS OWN eos (the triggering token is
+    dropped; rows that finish early are right-padded with ``eos_id``).
+    ``ref_eos_semantics=True`` restores the reference quirk — stop only
+    when ALL rows sample eos in the same step, otherwise a row's eos
+    neither stops it nor is dropped (generate.py:68-73) — for bit-parity
+    against the reference. ``return_n_generated=True`` additionally
+    returns the per-row generated-token counts (B,).
     """
     context_size = context_size or cfg.context_length
     token_ids = jnp.asarray(token_ids, jnp.int32)
@@ -163,12 +241,13 @@ def generate(params, cfg: ModelConfig, token_ids, max_new_tokens: int,
                                       jnp.asarray(Tp, jnp.int32), rng,
                                       jnp.asarray(max_new_tokens, jnp.int32),
                                       budget, float(temperature),
-                                      top_k, eos_id)
+                                      top_k, eos_id, bool(ref_eos_semantics))
         # ONE device_get for both results: on remote/tunnel backends each
         # transfer costs ~100ms of latency regardless of size (measured
         # r4: separate int(n)+asarray(buf) fetches added 119ms/call)
         buf_np, n = jax.device_get((buf, n_gen))
-        return buf_np[:, : Tp + int(n)]
+        out = buf_np[:, : Tp + int(np.max(n))]
+        return (out, np.asarray(n)) if return_n_generated else out
 
     # Sliding-window fallback — the reference's per-token recompute semantics
     # (generate.py:36-73), but with ONE compiled shape: windows shorter than
@@ -177,7 +256,9 @@ def generate(params, cfg: ModelConfig, token_ids, max_new_tokens: int,
     # growing prompt length would trigger a fresh XLA compile.
     fwd = jax.jit(lambda p, t: forward(p, cfg, t))
     ids = np.asarray(token_ids)
-    for _ in range(max_new_tokens):
+    done = np.zeros((B,), bool)
+    n_gen = np.zeros((B,), np.int32)
+    for i in range(max_new_tokens):
         cur = ids.shape[1]
         if cur >= context_size:
             window = ids[:, -context_size:]
@@ -187,12 +268,24 @@ def generate(params, cfg: ModelConfig, token_ids, max_new_tokens: int,
                 [ids, np.zeros((B, context_size - cur), ids.dtype)], axis=1)
             last = cur - 1
         logits = fwd(params, jnp.asarray(window))[:, last]
-        rng, sub = jax.random.split(rng)
+        sub = token_rng(rng, i)
         nxt = np.asarray(_sample_token(logits, sub, float(temperature), top_k))
-        if eos_id is not None and (nxt == eos_id).all():
-            break
-        ids = np.concatenate([ids, nxt[:, None].astype(ids.dtype)], axis=1)
-    return ids
+        if ref_eos_semantics:
+            if eos_id is not None and (nxt == eos_id).all():
+                break
+            ids = np.concatenate([ids, nxt[:, None].astype(ids.dtype)],
+                                 axis=1)
+            n_gen += 1
+        else:
+            if eos_id is not None:
+                done |= ~done & (nxt == eos_id)
+            if done.all():
+                break
+            col = np.where(~done, nxt, eos_id if eos_id is not None else 0)
+            ids = np.concatenate([ids, col[:, None].astype(ids.dtype)],
+                                 axis=1)
+            n_gen += (~done).astype(np.int32)
+    return (ids, n_gen) if return_n_generated else ids
 
 
 def text_to_token_ids(text: str, tokenizer) -> np.ndarray:

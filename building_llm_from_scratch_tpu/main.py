@@ -71,9 +71,10 @@ from building_llm_from_scratch_tpu.utils.seeding import (
 logger = setup_logger("main")
 
 
-def main(args) -> Trainer:
-    """Run one training/finetuning job from parsed args; returns the
-    Trainer (with its loss history) for callers/tests."""
+def main(args):
+    """Run one job from parsed args: training/finetuning (returns the
+    Trainer with its loss history) or --mode serve (returns the
+    DecodeEngine with its serve stats) for callers/tests."""
     import jax
 
     # 1. distributed runtime + reproducibility (reference main.py:49-58)
@@ -98,6 +99,15 @@ def main(args) -> Trainer:
     cfg = comps.cfg
     metric_logger.write_header(
         **run_metadata(args=args, cfg=cfg, plan=comps.plan))
+
+    # serve mode: the continuous-batching decode engine (serving/) owns
+    # its own run loop — warmup + frontends on the components built above,
+    # no trainer
+    if getattr(args, "mode", "train") == "serve":
+        from building_llm_from_scratch_tpu.serving.frontend import run_serve
+
+        return run_serve(args, comps, metric_logger)
+
     # constructed here, STARTED just before training inside the
     # try/finally below: starting now would leak the watcher thread if
     # loader/trainer setup raises, and start() is what arms the
@@ -236,7 +246,7 @@ def main(args) -> Trainer:
     return trainer
 
 
-def run(argv=None) -> Trainer:
+def run(argv=None):
     """Console entry: parse flags, run."""
     return main(get_args(argv))
 

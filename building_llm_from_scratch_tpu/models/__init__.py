@@ -10,18 +10,24 @@ import jax
 
 from building_llm_from_scratch_tpu.configs import ModelConfig, get_config
 from building_llm_from_scratch_tpu.models.transformer import (
+    decode_slots,
     forward,
     forward_with_cache,
     init_cache,
     init_params,
+    init_slot_cache,
+    prefill_into_slot,
 )
 
 __all__ = [
     "build_model",
+    "decode_slots",
     "forward",
     "forward_with_cache",
     "init_cache",
     "init_params",
+    "init_slot_cache",
+    "prefill_into_slot",
 ]
 
 

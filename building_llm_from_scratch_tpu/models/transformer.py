@@ -620,3 +620,124 @@ def forward_with_cache(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                         preferred_element_type=jnp.float32)
     new_cache = {"k": new_k, "v": new_v, "length": length + Tq}
     return logits, new_cache
+
+
+# ---------------------------------------------------------------------------
+# Slot-batched decode path (serving/engine.py)
+#
+# The one-shot decode above shares ONE scalar ``length`` across the whole
+# batch — every row is the same request family. The continuous-batching
+# engine instead keeps a fixed (n_slots, Tmax) cache where every row is an
+# INDEPENDENT request at its own sequence length: prefill writes one
+# request's prompt k/v into one slot, and a decode tick advances all active
+# slots by one token with per-row positions/lengths. Both are static-shape
+# programs: XLA compiles one prefill per prompt-length bucket and exactly
+# one decode step.
+# ---------------------------------------------------------------------------
+
+def init_slot_cache(cfg: ModelConfig, n_slots: int, max_length: int) -> Params:
+    """Per-layer (n_slots, Hkv, Tmax, hd) k/v buffers; lengths are host
+    state (serving/engine.py), not part of the device cache."""
+    shape = (n_slots, cfg.n_kv_groups, max_length, cfg.head_dim)
+    return {
+        "k": [jnp.zeros(shape, cfg.jax_dtype) for _ in range(cfg.n_layers)],
+        "v": [jnp.zeros(shape, cfg.jax_dtype) for _ in range(cfg.n_layers)],
+    }
+
+
+def prefill_into_slot(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
+                      prompt_len: jnp.ndarray, slot: jnp.ndarray,
+                      cache: Params, blocks_list: Optional[list] = None
+                      ) -> Tuple[jnp.ndarray, Params]:
+    """Run one request's prompt (``tokens`` (1, Tpb), right-padded to its
+    length bucket) and write its k/v panes into row ``slot`` of the slot
+    cache; returns (last-real-position logits (V,), updated cache).
+
+    Attention here is plain causal self-attention over the prompt itself
+    (nothing earlier lives in the slot), with ``kv_length=prompt_len``
+    masking the pad keys; the pad positions' k/v land in the cache as
+    garbage and stay masked by the engine's per-slot lengths.
+    """
+    _, Tpb = tokens.shape
+    rope = _rope_tables(cfg)
+    positions = jnp.arange(Tpb)
+    x = _embed(cfg, params, tokens, positions, None, True)
+    if blocks_list is None:
+        blocks_list = unstack_blocks(params, cfg)
+    new_k, new_v = [], []
+    for p, K, V in zip(blocks_list, cache["k"], cache["v"]):
+        h = _norm(cfg, p["norm1"], x)
+        q, k, v = _qkv_proj(cfg, p["attn"], h, rope, positions)
+        out = causal_attention(q, k, v, q_positions=positions,
+                               kv_length=prompt_len)
+        # (1, Tpb, Hkv, hd) -> cache-native (1, Hkv, Tpb, hd) pane at
+        # (slot, 0, 0, 0); Tpb <= Tmax by the engine's admission check
+        K = jax.lax.dynamic_update_slice(
+            K, k.transpose(0, 2, 1, 3).astype(K.dtype), (slot, 0, 0, 0))
+        V = jax.lax.dynamic_update_slice(
+            V, v.transpose(0, 2, 1, 3).astype(V.dtype), (slot, 0, 0, 0))
+        new_k.append(K)
+        new_v.append(V)
+        x = x + _attn_out_proj(p["attn"], out, 1, Tpb)
+        x = x + _mlp(cfg, p["mlp"], _norm(cfg, p["norm2"], x))
+    x = _norm(cfg, params["final_norm"], x)
+    last = jax.lax.dynamic_slice(x, (0, prompt_len - 1, 0),
+                                 (1, 1, x.shape[-1]))
+    logits = jnp.einsum("btd,dv->btv", last, params["head"]["weight"],
+                        preferred_element_type=jnp.float32)
+    return logits[0, 0], {"k": new_k, "v": new_v}
+
+
+def decode_slots(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
+                 lengths: jnp.ndarray, cache: Params,
+                 blocks_list: Optional[list] = None
+                 ) -> Tuple[jnp.ndarray, Params]:
+    """One decode tick for the whole slot batch: ``tokens`` (S, 1) are each
+    slot's last accepted token, ``lengths`` (S,) its valid cache prefix.
+    Appends each row's k/v at ITS offset (ops/decode_step.slot_cache_append
+    — pallas in-place on TPU) and attends with per-row masks; returns
+    (fp32 logits (S, V), updated cache). Free/finished slots compute
+    garbage rows the engine ignores — the shapes never change, so XLA
+    compiles exactly one decode program.
+    """
+    rope = _rope_tables(cfg)
+    S = tokens.shape[0]
+    lengths = lengths.astype(jnp.int32)
+    positions = lengths[:, None]                       # (S, 1)
+    x = _embed(cfg, params, tokens, positions, None, True)
+    if blocks_list is None:
+        blocks_list = unstack_blocks(params, cfg)
+
+    from building_llm_from_scratch_tpu.ops.decode_step import (
+        slot_cache_append,
+        supports_shape as _fds_supports,
+    )
+
+    Tmax = cache["k"][0].shape[2]
+    use_fused_step = (jax.default_backend() == "tpu"
+                      and _fds_supports(1, Tmax, cfg.head_dim))
+
+    new_k, new_v = [], []
+    for p, K, V in zip(blocks_list, cache["k"], cache["v"]):
+        h = _norm(cfg, p["norm1"], x)
+        q, k, v = _qkv_proj(cfg, p["attn"], h, rope, positions)
+        if use_fused_step:
+            from building_llm_from_scratch_tpu.ops.decode_step import (
+                fused_decode_step,
+            )
+
+            out, K, V = fused_decode_step(q, k.astype(K.dtype),
+                                          v.astype(V.dtype), K, V, lengths)
+        else:
+            K = slot_cache_append(K, k.transpose(0, 2, 1, 3), lengths)
+            V = slot_cache_append(V, v.transpose(0, 2, 1, 3), lengths)
+            out = decode_attention(q, K, V, q_positions=positions,
+                                   kv_length=lengths + 1)
+        new_k.append(K)
+        new_v.append(V)
+        x = x + _attn_out_proj(p["attn"], out, S, 1)
+        x = x + _mlp(cfg, p["mlp"], _norm(cfg, p["norm2"], x))
+    x = _norm(cfg, params["final_norm"], x)
+    logits = jnp.einsum("btd,dv->btv", x, params["head"]["weight"],
+                        preferred_element_type=jnp.float32)
+    return logits[:, 0], {"k": new_k, "v": new_v}

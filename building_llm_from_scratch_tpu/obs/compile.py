@@ -251,18 +251,33 @@ def aot_compile(fn: Callable, *args) -> Tuple[Any, Dict[str, Any]]:
 
 
 class CompileWatcher:
-    """Wraps a jitted train step: AOT-compiles per argument signature,
-    emits ``compile``/``recompile`` telemetry, and exposes the HLO-measured
+    """Wraps a jitted callable: AOT-compiles per argument signature, emits
+    ``compile``/``recompile`` telemetry, and exposes the HLO-measured
     FLOPs for the trainer's MFU cross-check.
 
-    Call-compatible with the wrapped step: ``watcher(state, batch)``.
+    Call-compatible with the wrapped step (any arity): for the trainer,
+    ``watcher(state, batch)``.
+
+    Two recompile policies:
+      - default (``multi_program=False``, the train step): ONE signature is
+        legitimate — any later signature change is a silent-perf-bug
+        recompile.
+      - ``multi_program=True`` (the serving engine's bucketed prefill /
+        decode programs): a KNOWN SET of signatures is legitimate. New
+        signatures during warmup are plain ``compile`` events; after the
+        caller ``freeze()``s the set, an unseen signature is a bucket miss
+        and emits ``recompile`` with the leaf diff — the silent latency
+        cliff the serving telemetry exists to surface.
     """
 
     def __init__(self, fn: Callable, label: str = "train_step",
-                 cache_dir: Optional[str] = None):
+                 cache_dir: Optional[str] = None,
+                 multi_program: bool = False):
         self._fn = fn
         self.label = label
         self.cache_dir = cache_dir
+        self.multi_program = multi_program
+        self.frozen = False
         self._compiled: Dict[Tuple, Callable] = {}
         self._last_sig: Optional[Tuple] = None
         self._disabled = False
@@ -288,9 +303,15 @@ class CompileWatcher:
         except OSError:
             return None
 
-    def _capture(self, sig: Tuple, state, batch) -> Callable:
+    def freeze(self) -> None:
+        """Close the legitimate-signature set (multi_program mode): the
+        serving engine calls this after warming its prefill buckets and
+        decode program — from here on, a new signature is a bucket miss."""
+        self.frozen = True
+
+    def _capture(self, sig: Tuple, *args) -> Callable:
         entries_before = self._cache_entries()
-        compiled, stats = aot_compile(self._fn, state, batch)
+        compiled, stats = aot_compile(self._fn, *args)
         entries_after = self._cache_entries()
         self.n_compiles += 1
         self.compile_seconds_total += stats["compile_seconds"]
@@ -298,8 +319,10 @@ class CompileWatcher:
         self.memory = stats.get("memory", {})
         n_tokens = None
         try:
+            batch = next(a for a in args
+                         if isinstance(a, dict) and "inputs" in a)
             n_tokens = int(batch["inputs"].size)
-        except (TypeError, KeyError, AttributeError):
+        except (StopIteration, TypeError, KeyError, AttributeError):
             pass
         if n_tokens and self.hlo_flops_per_step:
             self.hlo_flops_per_token = self.hlo_flops_per_step / n_tokens
@@ -341,20 +364,23 @@ class CompileWatcher:
         # tooling) read the step function's name
         return getattr(self._fn, "__name__", self.label)
 
-    def __call__(self, state, batch):
+    def __call__(self, *args):
         if self._disabled:
-            return self._fn(state, batch)
-        key = (fast_signature(state), fast_signature(batch))
+            return self._fn(*args)
+        key = tuple(fast_signature(a) for a in args)
         fn = self._compiled.get(key)
         if fn is None:
             # only a miss pays for the human-readable path-string
             # signature (the diff needs leaf names); steady-state steps
             # never build strings
-            sig = (tree_signature(state), tree_signature(batch))
-            if self._last_sig is not None:
+            sig = tuple(tree_signature(a) for a in args)
+            is_recompile = (self.frozen if self.multi_program
+                            else self._last_sig is not None)
+            if is_recompile:
                 self.n_recompiles += 1
-                diff = [d for pair in zip(self._last_sig, sig)
-                        for d in signature_diff(*pair)]
+                diff = ([d for pair in zip(self._last_sig, sig)
+                         for d in signature_diff(*pair)]
+                        if self._last_sig is not None else [])
                 sink = get_metrics()
                 # a tree-wide drift (fsdp opt-state resharding, resume
                 # dtype change) diffs every leaf — cap the serialized row
@@ -369,7 +395,7 @@ class CompileWatcher:
                     "%s RECOMPILE #%d: argument signature changed (%s)",
                     self.label, self.n_recompiles, shown or "unknown leaf")
             try:
-                fn = self._capture(sig, state, batch)
+                fn = self._capture(sig, *args)
             except Exception as e:
                 # telemetry must not kill the run: fall back to the plain
                 # jit path (which will surface REAL trace errors itself)
@@ -380,10 +406,10 @@ class CompileWatcher:
                 get_metrics().event("compile_fallback", label=self.label,
                                     error=f"{type(e).__name__}: {e}")
                 self._disabled = True
-                return self._fn(state, batch)
+                return self._fn(*args)
             self._compiled[key] = fn
             self._last_sig = sig
-        return fn(state, batch)
+        return fn(*args)
 
 
 def enable_persistent_cache(cache_dir: str) -> None:

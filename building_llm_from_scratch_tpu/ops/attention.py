@@ -253,8 +253,8 @@ def decode_attention(
     k_cache: jnp.ndarray,         # (B, Hkv, Tmax, D) — cache-native layout
     v_cache: jnp.ndarray,         # (B, Hkv, Tmax, D)
     *,
-    q_positions: jnp.ndarray,     # (Tq,) absolute positions
-    kv_length: jnp.ndarray,       # scalar: valid cache prefix
+    q_positions: jnp.ndarray,     # (Tq,) or (B, Tq) absolute positions
+    kv_length: jnp.ndarray,       # scalar or (B,): valid cache prefix
 ) -> jnp.ndarray:
     """Attention for KV-cache decode, consuming the cache in its OWN
     (B, H, T, D) layout.
@@ -266,6 +266,10 @@ def decode_attention(
     score/value einsums batch over (B, H) directly, so the cache streams
     without re-layout. Exact same math/masking as the xla path with
     ``q_positions``/``kv_length``; no dropout (decode is eval-only).
+
+    Per-row ``q_positions`` (B, Tq) + ``kv_length`` (B,) serve the serving
+    engine's slot batch, where every row is a different request at a
+    different sequence length (serving/engine.py).
     """
     B, Tq, Hq, D = q.shape
     _, Hkv, Tkv, _ = k_cache.shape
@@ -276,9 +280,16 @@ def decode_attention(
     scores = jnp.einsum("bhgqd,bhkd->bhgqk", qg, k_cache,
                         preferred_element_type=jnp.float32) * scale
     kv_pos = jnp.arange(Tkv)
-    mask = (q_positions[:, None] >= kv_pos[None, :]) \
-        & (kv_pos[None, :] < kv_length)
-    scores = jnp.where(mask[None, None, None], scores,
+    if q_positions.ndim == 2:
+        # per-row positions/lengths: mask (B, Tq, Tkv) -> (B, 1, 1, Tq, Tkv)
+        mask = (q_positions[:, :, None] >= kv_pos[None, None, :]) \
+            & (kv_pos[None, None, :] < jnp.reshape(kv_length, (-1, 1, 1)))
+        mask = mask[:, None, None]
+    else:
+        mask = (q_positions[:, None] >= kv_pos[None, :]) \
+            & (kv_pos[None, :] < kv_length)
+        mask = mask[None, None, None]
+    scores = jnp.where(mask, scores,
                        jnp.asarray(_NEG_INF, scores.dtype))
     weights = jax.nn.softmax(scores, axis=-1).astype(v_cache.dtype)
     out = jnp.einsum("bhgqk,bhkd->bhgqd", weights, v_cache)

@@ -74,13 +74,40 @@ def _kernel(len_ref, q_ref, kn_ref, vn_ref, K_ref, V_ref,
         preferred_element_type=jnp.float32).astype(o_ref.dtype)
 
 
+def slot_cache_append(cache: jnp.ndarray, new: jnp.ndarray,
+                      lengths: jnp.ndarray) -> jnp.ndarray:
+    """Batched slot-indexed cache append: write ``new`` (B, Hkv, Tq, hd)
+    into ``cache`` (B, Hkv, Tmax, hd) at PER-ROW time offsets ``lengths``
+    (B,) — the continuous-batching primitive where every batch row is a
+    different request at a different sequence length.
+
+    Scalar ``lengths`` degrades to the shared-offset single
+    ``dynamic_update_slice`` the one-shot decode path uses. The vmap'd
+    per-row form lowers to a batched DUS; on TPU the serving engine routes
+    single-token appends through the pallas kernel below instead (which
+    additionally aliases the cache in place).
+    """
+    lengths = jnp.asarray(lengths)
+    if lengths.ndim == 0:
+        return jax.lax.dynamic_update_slice(
+            cache, new.astype(cache.dtype), (0, 0, lengths, 0))
+
+    def one(c, n, t):                      # c (Hkv, Tmax, hd), n (Hkv, Tq, hd)
+        return jax.lax.dynamic_update_slice(c, n.astype(c.dtype), (0, t, 0))
+
+    return jax.vmap(one)(cache, new, lengths.astype(jnp.int32))
+
+
 def fused_decode_step(q, k_new, v_new, k_cache, v_cache, length):
     """Append k_new/v_new at ``length`` (IN PLACE via aliasing) and attend.
 
     q:                (B, Tq, Hq, hd)   — model layout, Tq small
     k_new, v_new:     (B, Tq, Hkv, hd)
     k_cache, v_cache: (B, Hkv, Tmax, hd)
-    length:           scalar int32 (valid prefix)
+    length:           scalar int32 (valid prefix), or (B,) per-row
+                      lengths for the slot-batched serving engine — the
+                      grid already runs one cell per batch row, so each
+                      cell simply reads ITS row's length from SMEM.
 
     Returns (out (B, Tq, Hq, hd), k_cache', v_cache').
     """
@@ -98,7 +125,9 @@ def fused_decode_step(q, k_new, v_new, k_cache, v_cache, length):
         qr = jnp.pad(qr, ((0, 0), (0, 0), (0, Rp - R), (0, 0)))
     knt = k_new.transpose(0, 2, 1, 3)                 # (B, Hkv, Tq, hd)
     vnt = v_new.transpose(0, 2, 1, 3)
-    len2 = jnp.reshape(length, (1, 1)).astype(jnp.int32)
+    # (B, 1) per-row lengths in SMEM; a scalar broadcasts to every row
+    len2 = jnp.broadcast_to(
+        jnp.reshape(jnp.asarray(length, jnp.int32), (-1, 1)), (B, 1))
 
     blk = lambda rows: pl.BlockSpec((1, Hkv, rows, hd),
                                     lambda b: (b, 0, 0, 0))
@@ -106,7 +135,7 @@ def fused_decode_step(q, k_new, v_new, k_cache, v_cache, length):
         functools.partial(_kernel, scale=1.0 / float(hd) ** 0.5),
         grid=(B,),
         in_specs=[
-            pl.BlockSpec((1, 1), lambda b: (0, 0),
+            pl.BlockSpec((1, 1), lambda b: (b, 0),
                          memory_space=pltpu.SMEM),
             blk(Rp), blk(Tq), blk(Tq), blk(Tmax), blk(Tmax),
         ],

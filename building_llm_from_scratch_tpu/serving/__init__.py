@@ -1,0 +1,41 @@
+"""Serving subsystem: continuous-batching decode engine.
+
+The reference stops at one-shot batch sampling (generate.py:4-75); this
+package is the runtime that turns the repo's decode primitives (static
+KV cache, fused decode step) into a server: a bounded ``RequestQueue``,
+an FCFS slot ``Scheduler``, the ``DecodeEngine`` tick loop, and two
+dependency-free frontends (JSONL batch, stdlib HTTP).
+
+    from building_llm_from_scratch_tpu.serving import (
+        DecodeEngine, SamplingParams)
+    engine = DecodeEngine(cfg, params, tokenizer, n_slots=8)
+    engine.warmup(); engine.start()
+    req = engine.submit("Every effort moves you",
+                        SamplingParams(max_new_tokens=64, seed=7))
+    for piece in req.stream():
+        print(piece, end="")
+    engine.shutdown()
+
+CLI: ``python -m building_llm_from_scratch_tpu --mode serve ...`` (or the
+installed ``bllm-tpu`` entry point) — see README "Serving".
+"""
+
+from building_llm_from_scratch_tpu.serving.engine import DecodeEngine
+from building_llm_from_scratch_tpu.serving.queue import (
+    QueueFullError,
+    RequestQueue,
+)
+from building_llm_from_scratch_tpu.serving.request import (
+    Request,
+    SamplingParams,
+)
+from building_llm_from_scratch_tpu.serving.scheduler import Scheduler
+
+__all__ = [
+    "DecodeEngine",
+    "QueueFullError",
+    "Request",
+    "RequestQueue",
+    "SamplingParams",
+    "Scheduler",
+]

@@ -1,0 +1,564 @@
+"""Continuous-batching decode engine (Orca-style, slot-scheduled).
+
+One fixed ``(n_slots, Tmax)`` KV cache; requests are admitted into free
+slots at step boundaries and retired the moment they finish, so XLA
+compiles exactly ONE decode program (and one prefill per prompt-length
+bucket) no matter how traffic arrives. The host loop per tick:
+
+    retire finished -> admit queued into free slots (prefill, bucketed)
+    -> one fused decode step for ALL slots (per-slot masks) -> stream
+
+Slot independence is total: every row carries its own length, sampling
+params and PRNG stream (``generate.token_rng`` fold-in on the request
+seed), so a request's tokens are identical whether it runs alone, in any
+slot, or next to arbitrary co-batched traffic — and identical to the
+one-shot ``generate()`` path (test-pinned).
+
+Telemetry (obs/metrics.py sink): per-request ``request_done`` events with
+queue-wait/TTFT/TPOT, slot-occupancy + queue-depth gauges, periodic
+``metrics`` rows with the decode token rate, and compile/recompile events
+from the ``CompileWatcher``-wrapped prefill/decode programs — after
+warmup, a prompt outside the warmed bucket set surfaces as a ``recompile``
+event with the leaf diff instead of a silent latency cliff.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+
+from building_llm_from_scratch_tpu.configs import ModelConfig
+from building_llm_from_scratch_tpu.generate import (
+    _bucket,
+    sample_tokens_dynamic,
+    token_rng,
+)
+from building_llm_from_scratch_tpu.models.transformer import (
+    decode_slots,
+    init_slot_cache,
+    prefill_into_slot,
+    unstack_blocks,
+)
+from building_llm_from_scratch_tpu.obs.compile import CompileWatcher
+from building_llm_from_scratch_tpu.obs.metrics import get_metrics
+from building_llm_from_scratch_tpu.serving.queue import (
+    QueueFullError,
+    RequestQueue,
+)
+from building_llm_from_scratch_tpu.serving.request import (
+    FINISH_EOS,
+    FINISH_ERROR,
+    FINISH_LENGTH,
+    FINISHED,
+    REJECTED,
+    RUNNING,
+    Request,
+    SamplingParams,
+    next_request_id,
+    resolve_eos,
+)
+from building_llm_from_scratch_tpu.serving.scheduler import Scheduler
+from building_llm_from_scratch_tpu.utils.logging import setup_logger
+
+logger = setup_logger(__name__)
+
+
+def _percentiles(values: Sequence[float], ps=(50, 95, 99)) -> dict:
+    if not values:
+        return {}
+    arr = np.asarray(values, np.float64)
+    return {f"p{p}": round(float(np.percentile(arr, p)), 6) for p in ps}
+
+
+class DecodeEngine:
+    """The serving runtime: slot-batched KV cache + request lifecycle.
+
+    Drive it either manually (``step()`` / ``run_until_idle()`` — what the
+    deterministic tests do) or with the background thread
+    (``start()`` / ``shutdown()`` — what the frontends do). ``submit()``
+    is thread-safe either way.
+    """
+
+    def __init__(self, cfg: ModelConfig, params, tokenizer=None, *,
+                 n_slots: int = 4, max_len: Optional[int] = None,
+                 max_queue: int = 64, max_top_k: int = 64,
+                 default_max_new_tokens: int = 128,
+                 warmup_prompt_cap: int = 256, metrics_every: int = 32,
+                 watch_compiles: bool = True):
+        import jax
+
+        self.cfg = cfg
+        self.params = params
+        self.tokenizer = tokenizer
+        self.n_slots = int(n_slots)
+        self.max_len = min(int(max_len or cfg.context_length),
+                           cfg.context_length)
+        self.max_top_k = min(int(max_top_k), cfg.vocab_size)
+        self.default_max_new_tokens = int(default_max_new_tokens)
+        self.warmup_prompt_cap = min(int(warmup_prompt_cap), self.max_len)
+        self.metrics_every = int(metrics_every)
+
+        self.queue = RequestQueue(max_queue)
+        self.scheduler = Scheduler(self.n_slots)
+        self.cache = init_slot_cache(cfg, self.n_slots, self.max_len)
+        self._blocks = unstack_blocks(params, cfg)
+
+        S = self.n_slots
+        # host-owned per-slot state; the device owns only the big k/v.
+        # PRNG key width depends on the configured impl (threefry (2,),
+        # rbg (4,)) — probe it instead of assuming
+        probe_key = np.asarray(_prng_key(0))
+        self._lengths = np.zeros((S,), np.int32)
+        self._last_tokens = np.zeros((S,), np.int32)
+        self._n_gen = np.zeros((S,), np.int32)
+        self._base_keys = np.zeros((S,) + probe_key.shape, probe_key.dtype)
+        self._temps = np.zeros((S,), np.float32)
+        self._topks = np.zeros((S,), np.int32)
+
+        # donate the cache panes: the caller always rebinds self.cache to
+        # the outputs, so XLA may alias input->output and the pallas
+        # in-place append really is in place (no per-tick full-cache copy)
+        prefill_jit = jax.jit(self._prefill_impl, donate_argnums=(0, 1))
+        decode_jit = jax.jit(self._decode_impl, donate_argnums=(0, 1))
+        if watch_compiles:
+            self._prefill = CompileWatcher(prefill_jit,
+                                           label="serve_prefill",
+                                           multi_program=True)
+            self._decode = CompileWatcher(decode_jit, label="serve_decode",
+                                          multi_program=True)
+        else:
+            self._prefill = prefill_jit
+            self._decode = decode_jit
+
+        self._lock = threading.RLock()
+        self._work = threading.Condition()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._dead: Optional[str] = None        # set by _fail_all
+        self.warmed_up = False
+
+        # rolling serve accounting (histogram material for request_done /
+        # serve_summary events and the frontends' reports); bounded so a
+        # long-running deployment holds the most recent window, not every
+        # request ever served
+        self.n_ticks = 0
+        self.tokens_generated = 0
+        self.requests_finished = 0
+        self.requests_rejected = 0
+        self.ttft_hist = collections.deque(maxlen=self._HIST_MAX)
+        self.tpot_hist = collections.deque(maxlen=self._HIST_MAX)
+        self.queue_wait_hist = collections.deque(maxlen=self._HIST_MAX)
+        self.e2e_hist = collections.deque(maxlen=self._HIST_MAX)
+        self._window_tokens = 0
+        self._window_t0 = time.monotonic()
+
+    # -- jitted programs (close over params/cfg/blocks so per-tick call
+    # signatures carry only the small mutable state + caches) -------------
+
+    def _prefill_impl(self, cache_k, cache_v, tokens, prompt_len, slot,
+                      base_key, temp, topk):
+        import jax.numpy as jnp
+
+        logits, cache = prefill_into_slot(
+            self.params, self.cfg, tokens, prompt_len, slot,
+            {"k": cache_k, "v": cache_v}, self._blocks)
+        key0 = token_rng(base_key, 0)
+        tok = sample_tokens_dynamic(
+            logits[None], key0[None], jnp.reshape(temp, (1,)),
+            jnp.reshape(topk, (1,)), self.max_top_k)[0]
+        return tok, cache["k"], cache["v"]
+
+    def _decode_impl(self, cache_k, cache_v, tokens, lengths, base_keys,
+                     n_gen, temps, topks):
+        import jax
+
+        logits, cache = decode_slots(
+            self.params, self.cfg, tokens[:, None], lengths,
+            {"k": cache_k, "v": cache_v}, self._blocks)
+        keys = jax.vmap(token_rng)(base_keys, n_gen)
+        nxt = sample_tokens_dynamic(logits, keys, temps, topks,
+                                    self.max_top_k)
+        return nxt, cache["k"], cache["v"]
+
+    # -- admission --------------------------------------------------------
+
+    def _bucket_len(self, n: int) -> int:
+        return min(_bucket(n), self.max_len)
+
+    def prompt_buckets(self) -> List[int]:
+        """The prompt-length buckets warmup compiles (one prefill program
+        each): every bucket value up to ``warmup_prompt_cap``. Prompts
+        longer than the cap still work — their first arrival pays a
+        compile, which the frozen watcher reports as a ``recompile``
+        (bucket miss)."""
+        vals = {self._bucket_len(1)}
+        b = 64
+        while b <= self.warmup_prompt_cap:
+            vals.add(self._bucket_len(b))
+            b += 64
+        # the clamped terminal bucket: when max_len is not a multiple of
+        # 64 the loop above never reaches it, yet in-capacity prompts
+        # bucket there (e.g. max_len=48 -> bucket 48)
+        vals.add(self._bucket_len(self.warmup_prompt_cap))
+        return sorted(vals)
+
+    def encode_prompt(self, prompt: Union[str, Sequence[int], np.ndarray]
+                      ) -> np.ndarray:
+        if isinstance(prompt, str):
+            if self.tokenizer is None:
+                raise ValueError("text prompt needs a tokenizer")
+            ids = self.tokenizer.encode(prompt)
+        else:
+            ids = prompt
+        ids = np.asarray(ids, np.int32).reshape(-1)
+        if ids.size < 1:
+            raise ValueError("empty prompt")
+        return ids
+
+    def submit(self, prompt, params: Optional[SamplingParams] = None,
+               block: bool = False, timeout: Optional[float] = None,
+               on_token=None) -> Request:
+        """Enqueue one request (thread-safe). ``block=False`` rejects with
+        ``QueueFullError`` when the bounded queue is at capacity;
+        ``block=True`` waits for space (backpressure)."""
+        if self._dead is not None:
+            raise RuntimeError(f"engine is dead: {self._dead}")
+        params = params or SamplingParams()
+        ids = self.encode_prompt(prompt)
+        if params.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if params.top_k is not None and not (
+                1 <= params.top_k <= self.max_top_k):
+            raise ValueError(
+                f"top_k={params.top_k} outside this engine's compiled "
+                f"capacity 1..{self.max_top_k} (raise max_top_k)")
+        total = int(ids.size) + params.max_new_tokens
+        if total > self.max_len:
+            raise ValueError(
+                f"prompt ({ids.size}) + max_new_tokens "
+                f"({params.max_new_tokens}) = {total} exceeds the "
+                f"engine's slot capacity {self.max_len}")
+        req = Request(next_request_id(), ids, params, on_token=on_token)
+        try:
+            self.queue.put(req, block=block, timeout=timeout)
+        except QueueFullError:
+            req.state = REJECTED
+            with self._lock:                   # submit() is thread-safe
+                self.requests_rejected += 1
+            get_metrics().event("request_rejected", request_id=req.id,
+                                queue_depth=len(self.queue))
+            req._mark_done()
+            raise
+        if self._dead is not None:
+            # raced _fail_all: a blocked put() can be woken by the death
+            # drain and append into the dead engine — nothing will ever
+            # process it, so fail it here instead of hanging result()
+            req.error = self._dead
+            req.finish_reason = FINISH_ERROR
+            req.state = FINISHED
+            req._mark_done()
+            raise RuntimeError(f"engine is dead: {self._dead}")
+        with self._work:
+            self._work.notify()
+        return req
+
+    def _admit(self, slot: int, req: Request) -> None:
+        Tp = int(req.prompt_ids.size)
+        Tpb = self._bucket_len(Tp)
+        padded = np.zeros((1, Tpb), np.int32)
+        padded[0, :Tp] = req.prompt_ids
+        base_key = np.asarray(_prng_key(req.params.seed))
+        temp = np.float32(req.params.temperature)
+        topk = np.int32(req.params.top_k or 0)
+        tok, k, v = self._prefill(self.cache["k"], self.cache["v"], padded,
+                                  np.int32(Tp), np.int32(slot), base_key,
+                                  temp, topk)
+        self.cache = {"k": k, "v": v}
+        req.state = RUNNING
+        req.slot = slot
+        req.t_admit = time.monotonic()
+        self._lengths[slot] = Tp
+        self._n_gen[slot] = 0
+        self._base_keys[slot] = base_key
+        self._temps[slot] = temp
+        self._topks[slot] = topk
+        self._accept_token(slot, req, int(tok))
+
+    # -- the tick ---------------------------------------------------------
+
+    def step(self) -> bool:
+        """One engine tick: admit into free slots, then one fused decode
+        step over the slot batch. Returns False when fully idle (no active
+        slots and nothing queued)."""
+        with self._lock:
+            # re-run admission until no progress: a request can finish
+            # DURING admission (eos on its first sampled token, or
+            # max_new_tokens=1), freeing its slot after admit_from already
+            # returned — without the retry those queued behind it would
+            # strand (step() would report idle with a non-empty queue)
+            while True:
+                admitted = self.scheduler.admit_from(self.queue)
+                for slot, req in admitted:
+                    self._admit(slot, req)
+                if not admitted:
+                    break
+            active = self.scheduler.active()
+            if not active:
+                # all slots free => admission drained the queue too
+                return False
+            nxt, k, v = self._decode(
+                self.cache["k"], self.cache["v"], self._last_tokens,
+                self._lengths, self._base_keys, self._n_gen, self._temps,
+                self._topks)
+            self.cache = {"k": k, "v": v}
+            nxt = np.asarray(nxt)
+            for slot, req in active:
+                # this tick wrote the slot's previous token at _lengths
+                self._lengths[slot] += 1
+                self._accept_token(slot, req, int(nxt[slot]))
+            self.n_ticks += 1
+            self._maybe_log_metrics()
+            return True
+
+    def run_until_idle(self) -> None:
+        while self.step():
+            pass
+
+    def _accept_token(self, slot: int, req: Request, tok: int) -> None:
+        eos = resolve_eos(req.params, self.cfg.eos_id)
+        if eos is not None and tok == eos:
+            # the triggering eos is dropped (generate()'s per-row
+            # semantics) and the slot frees this boundary
+            self._finish(slot, req, FINISH_EOS)
+            return
+        now = time.monotonic()
+        if req.t_first_token is None:
+            req.t_first_token = now
+        req.output_ids.append(tok)
+        self._last_tokens[slot] = tok
+        self._n_gen[slot] = len(req.output_ids)
+        self.tokens_generated += 1
+        self._window_tokens += 1
+        piece = self._detok_piece(req)
+        if req.on_token is not None:
+            req.on_token(req, tok, piece)
+        if piece:
+            req._push_piece(piece)
+        if len(req.output_ids) >= req.params.max_new_tokens:
+            self._finish(slot, req, FINISH_LENGTH)
+
+    #: per-histogram cap: serve_summary percentiles cover the most recent
+    #: window of finished requests at O(1) memory
+    _HIST_MAX = 8192
+
+    #: max tokens a partial multi-byte char may hold back detokenization
+    #: before committing anyway (bounds the re-decoded tail per token)
+    _DETOK_HOLD_MAX = 16
+
+    def _detok_piece(self, req: Request, final: bool = False) -> str:
+        """Incremental detokenization: decode only the uncommitted tail
+        (O(tail) per token, not O(total)). A tail ending in a replacement
+        char is a partial multi-byte sequence the next token may complete
+        — hold it (return "") rather than commit a mangled boundary,
+        up to ``_DETOK_HOLD_MAX`` tokens; ``final`` flushes regardless."""
+        if self.tokenizer is None:
+            return ""
+        tail_ids = req.output_ids[req._detok_start:]
+        if not tail_ids:
+            return ""
+        try:
+            tail = self.tokenizer.decode([int(t) for t in tail_ids])
+        except Exception:                      # partial byte sequences etc.
+            return ""
+        if (not final and tail.endswith("�")
+                and len(tail_ids) < self._DETOK_HOLD_MAX):
+            return ""
+        req.text += tail
+        req._detok_start = len(req.output_ids)
+        return tail
+
+    def _finish(self, slot: int, req: Request, reason: str) -> None:
+        tail = self._detok_piece(req, final=True)  # flush any held bytes
+        if tail:
+            req._push_piece(tail)
+        req.state = FINISHED
+        req.finish_reason = reason
+        req.t_finish = time.monotonic()
+        self.scheduler.retire(slot)
+        self._lengths[slot] = 0
+        self._last_tokens[slot] = 0
+        self._n_gen[slot] = 0
+        self._temps[slot] = 0.0
+        self._topks[slot] = 0
+        self.requests_finished += 1
+        for hist, val in ((self.ttft_hist, req.ttft_s()),
+                          (self.tpot_hist, req.tpot_s()),
+                          (self.queue_wait_hist, req.queue_wait_s()),
+                          (self.e2e_hist, req.e2e_s())):
+            if val is not None:
+                hist.append(val)
+        sink = get_metrics()
+        sink.event("request_done", **req.summary())
+        sink.gauge("slot_occupancy", self.scheduler.occupancy())
+        sink.gauge("queue_depth", len(self.queue))
+        req._mark_done()
+        with self._work:
+            self._work.notify_all()
+
+    def _maybe_log_metrics(self) -> None:
+        if self.metrics_every <= 0 or self.n_ticks % self.metrics_every:
+            return
+        now = time.monotonic()
+        dt = max(now - self._window_t0, 1e-9)
+        sink = get_metrics()
+        sink.gauge("slot_occupancy", self.scheduler.occupancy())
+        sink.gauge("queue_depth", len(self.queue))
+        sink.log_metrics(self.n_ticks,
+                         serve_tok_s=round(self._window_tokens / dt, 2),
+                         requests_finished=self.requests_finished,
+                         tokens_generated=self.tokens_generated)
+        self._window_tokens = 0
+        self._window_t0 = now
+
+    # -- warmup / compile discipline --------------------------------------
+
+    def warmup(self) -> None:
+        """Compile the legitimate program set up front — one prefill per
+        prompt bucket + THE decode step — then freeze the watchers so any
+        later signature is reported as a bucket-miss ``recompile``. The
+        warmup traffic runs through slot 0 with throwaway state; host
+        state is reset after."""
+        t0 = time.monotonic()
+        buckets = self.prompt_buckets()
+        zero_key = np.zeros_like(self._base_keys[0])
+        for Tpb in buckets:
+            dummy = np.zeros((1, Tpb), np.int32)
+            tok, k, v = self._prefill(
+                self.cache["k"], self.cache["v"], dummy, np.int32(1),
+                np.int32(0), zero_key, np.float32(0.0), np.int32(0))
+            self.cache = {"k": k, "v": v}
+        nxt, k, v = self._decode(
+            self.cache["k"], self.cache["v"], self._last_tokens,
+            self._lengths, self._base_keys, self._n_gen, self._temps,
+            self._topks)
+        self.cache = {"k": k, "v": v}
+        np.asarray(nxt)                       # block until compiled + ran
+        if isinstance(self._prefill, CompileWatcher):
+            self._prefill.freeze()
+            self._decode.freeze()
+        self._lengths[:] = 0
+        self._last_tokens[:] = 0
+        self._n_gen[:] = 0
+        self.warmed_up = True
+        get_metrics().event(
+            "serve_warmup", n_prefill_buckets=len(buckets),
+            buckets=buckets, seconds=round(time.monotonic() - t0, 3),
+            n_slots=self.n_slots, max_len=self.max_len)
+        logger.info("Serving warmup: %d prefill buckets %s + 1 decode "
+                    "program in %.2fs", len(buckets), buckets,
+                    time.monotonic() - t0)
+
+    @property
+    def n_recompiles(self) -> int:
+        if isinstance(self._decode, CompileWatcher):
+            return self._decode.n_recompiles + self._prefill.n_recompiles
+        return 0
+
+    # -- background loop ---------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    progressed = self.step()
+                except Exception as e:          # noqa: BLE001 — must not
+                    # die silently: callers block on result() forever and
+                    # shutdown(drain=True) spins if requests just vanish
+                    logger.exception("decode-engine loop died")
+                    self._fail_all(f"engine loop error: {e!r}")
+                    return
+                if not progressed:
+                    with self._work:
+                        self._work.wait(timeout=0.05)
+
+        self._thread = threading.Thread(target=loop, name="decode-engine",
+                                        daemon=True)
+        self._thread.start()
+
+    def _fail_all(self, msg: str) -> None:
+        """Fail every in-flight and queued request (engine loop death):
+        set ``req.error`` so ``result()`` raises instead of hanging.
+        Marks the engine dead — later ``submit()`` calls raise."""
+        with self._lock:
+            self._dead = msg
+            failed = 0
+            for slot, req in self.scheduler.active():
+                req.error = msg
+                req.finish_reason = FINISH_ERROR
+                req.state = FINISHED
+                self.scheduler.retire(slot)
+                req._mark_done()
+                failed += 1
+            while True:
+                req = self.queue.get_nowait()
+                if req is None:
+                    break
+                req.error = msg
+                req.finish_reason = FINISH_ERROR
+                req.state = FINISHED
+                req._mark_done()
+                failed += 1
+            get_metrics().event("serve_error", error=msg, n_failed=failed)
+        with self._work:
+            self._work.notify_all()
+
+    def shutdown(self, drain: bool = True) -> None:
+        """Stop the engine loop; with ``drain`` (default) finish everything
+        queued first. Emits the ``serve_summary`` event with the latency
+        histograms' percentiles."""
+        if self._thread is not None:
+            if drain:
+                while ((self.scheduler.n_active or len(self.queue))
+                       and self._thread.is_alive()):
+                    time.sleep(0.01)
+            self._stop.set()
+            with self._work:
+                self._work.notify_all()
+            self._thread.join(timeout=10)
+            self._thread = None
+        elif drain:
+            self.run_until_idle()
+        get_metrics().event("serve_summary", **self.stats())
+
+    def stats(self) -> dict:
+        with self._lock:                       # vs a mid-tick _finish()
+            out = {
+                "requests_finished": self.requests_finished,
+                "requests_rejected": self.requests_rejected,
+                "tokens_generated": self.tokens_generated,
+                "n_ticks": self.n_ticks,
+                "n_recompiles": self.n_recompiles,
+            }
+            hists = [("ttft_s", list(self.ttft_hist)),
+                     ("tpot_s", list(self.tpot_hist)),
+                     ("queue_wait_s", list(self.queue_wait_hist)),
+                     ("e2e_s", list(self.e2e_hist))]
+        for name, hist in hists:
+            pct = _percentiles(hist)
+            if pct:
+                out[name] = pct
+        return out
+
+
+def _prng_key(seed: int):
+    import jax
+
+    return jax.random.PRNGKey(seed)

@@ -1,0 +1,207 @@
+"""Serving frontends: the ``serve`` CLI mode (JSONL batch + minimal HTTP).
+
+Two dependency-free ways to put load on the engine:
+
+  - JSONL batch (``--serve_prompts requests.jsonl``): one request per
+    line — ``{"prompt": "...", "max_new_tokens": 32, "temperature": 0.7,
+    "top_k": 40, "seed": 1}`` (or ``"prompt_ids": [..]``). Results stream
+    to ``--serve_out`` (default stdout) as JSONL, one line per request in
+    submission order. Submission uses blocking backpressure: a full queue
+    stalls the reader instead of rejecting.
+  - HTTP (``--serve_port``): a stdlib ``http.server`` endpoint —
+    ``POST /generate`` with the same JSON fields returns the generated
+    text + telemetry; a full queue returns 429 (reject-over-capacity);
+    ``GET /healthz`` reports slot/queue state.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import List, Optional
+
+from building_llm_from_scratch_tpu.serving.engine import DecodeEngine
+from building_llm_from_scratch_tpu.serving.queue import QueueFullError
+from building_llm_from_scratch_tpu.serving.request import (
+    Request,
+    SamplingParams,
+)
+from building_llm_from_scratch_tpu.utils.logging import setup_logger
+
+logger = setup_logger(__name__)
+
+
+def params_from_record(rec: dict, default_max_new: int) -> SamplingParams:
+    return SamplingParams(
+        max_new_tokens=int(rec.get("max_new_tokens", default_max_new)),
+        temperature=float(rec.get("temperature", 0.0)),
+        top_k=(int(rec["top_k"]) if rec.get("top_k") else None),
+        seed=int(rec.get("seed", 0)),
+        eos_id=(int(rec["eos_id"]) if "eos_id" in rec
+                and rec["eos_id"] is not None else None),
+        ignore_eos=bool(rec.get("ignore_eos", False)),
+    )
+
+
+def result_record(req: Request, text: Optional[str] = None) -> dict:
+    rec = req.summary()
+    rec["token_ids"] = [int(t) for t in req.output_ids]
+    rec["text"] = req.text if text is None else text
+    return rec
+
+
+def serve_jsonl(engine: DecodeEngine, prompts_path: str,
+                out_path: Optional[str], default_max_new: int) -> List[dict]:
+    """Pump a JSONL request file through the engine (blocking
+    backpressure), write one result line per request in submission order."""
+    handles: List[Request] = []
+    with open(prompts_path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            prompt = rec.get("prompt_ids", rec.get("prompt"))
+            if prompt is None:
+                raise ValueError(
+                    f"{prompts_path}:{lineno}: needs 'prompt' or "
+                    "'prompt_ids'")
+            handles.append(engine.submit(
+                prompt, params_from_record(rec, default_max_new),
+                block=True))
+    # write each result as its in-order handle completes (flushed per
+    # line) so finished work is durable even if a later request crashes
+    # the process
+    results: List[dict] = []
+    out = open(out_path, "w") if out_path else sys.stdout
+    try:
+        for h in handles:
+            rec = result_record(h.result())
+            results.append(rec)
+            out.write(json.dumps(rec) + "\n")
+            out.flush()
+    finally:
+        if out_path:
+            out.close()
+    logger.info("Served %d JSONL requests (%d tokens).", len(results),
+                sum(r["n_tokens"] for r in results))
+    return results
+
+
+# ---------------------------------------------------------------------------
+# HTTP endpoint (stdlib only)
+# ---------------------------------------------------------------------------
+
+def make_http_server(engine: DecodeEngine, port: int,
+                     host: str = "127.0.0.1",
+                     request_timeout_s: float = 300.0):
+    """Build (not start) a ThreadingHTTPServer bound to ``port`` (0 = any
+    free port; read the actual one off ``server.server_address``).
+    Loopback-only by default — the endpoint is unauthenticated, so
+    exposing it (``host="0.0.0.0"`` / ``--serve_host``) is opt-in."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):          # route through our logger
+            logger.debug("http: " + fmt, *args)
+
+        def _json(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path != "/healthz":
+                return self._json(404, {"error": "unknown path"})
+            self._json(200, {
+                "slots": engine.n_slots,
+                "active": engine.scheduler.n_active,
+                "queue_depth": len(engine.queue),
+                "queue_capacity": engine.queue.max_size,
+                "warmed_up": engine.warmed_up,
+            })
+
+        def do_POST(self):
+            if self.path != "/generate":
+                return self._json(404, {"error": "unknown path"})
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                rec = json.loads(self.rfile.read(n) or b"{}")
+                prompt = rec.get("prompt_ids", rec.get("prompt"))
+                if prompt is None:
+                    return self._json(
+                        400, {"error": "missing 'prompt'/'prompt_ids'"})
+                params = params_from_record(
+                    rec, engine.default_max_new_tokens)
+            except (ValueError, TypeError, json.JSONDecodeError) as e:
+                # TypeError: wrong-typed JSON fields (int({}) etc.) —
+                # still the client's malformed input, still a 400
+                return self._json(400, {"error": str(e)})
+            try:
+                handle = engine.submit(prompt, params, block=False)
+            except QueueFullError:
+                return self._json(429, {
+                    "error": "request queue full — retry later",
+                    "queue_capacity": engine.queue.max_size})
+            except ValueError as e:
+                return self._json(400, {"error": str(e)})
+            except RuntimeError as e:           # engine is dead
+                return self._json(500, {"error": str(e)})
+            try:
+                handle.result(timeout=request_timeout_s)
+            except TimeoutError as e:
+                return self._json(504, {"error": str(e)})
+            except RuntimeError as e:           # engine failed the request
+                return self._json(500, {"error": str(e)})
+            self._json(200, result_record(handle))
+
+    return ThreadingHTTPServer((host, port), Handler)
+
+
+def serve_http(engine: DecodeEngine, port: int,
+               host: str = "127.0.0.1") -> None:
+    server = make_http_server(engine, port, host=host)
+    host, real_port = server.server_address[:2]
+    logger.info("Serving on http://%s:%d (POST /generate, GET /healthz); "
+                "Ctrl-C to stop.", host, real_port)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        logger.info("Shutting down HTTP server.")
+    finally:
+        server.server_close()
+
+
+# ---------------------------------------------------------------------------
+# the `serve` run mode (main.py dispatches here)
+# ---------------------------------------------------------------------------
+
+def run_serve(args, comps, metric_logger) -> DecodeEngine:
+    """Warm the engine and serve --serve_prompts and/or --serve_port.
+    ``comps``/``metric_logger`` come from main.py's shared bootstrap
+    (metrics sink + compile cache + build_components + run-metadata
+    header) so serve telemetry can't diverge from training telemetry.
+    Returns the (shut-down) engine for callers/tests."""
+    engine = DecodeEngine(
+        comps.cfg, comps.params, comps.tokenizer,
+        n_slots=args.serve_slots,
+        max_len=(args.serve_max_len or None),
+        max_queue=args.serve_max_queue,
+        max_top_k=args.serve_max_top_k,
+        default_max_new_tokens=args.serve_max_new_tokens,
+    )
+    engine.warmup()
+    engine.start()
+    try:
+        if args.serve_prompts:
+            serve_jsonl(engine, args.serve_prompts, args.serve_out,
+                        args.serve_max_new_tokens)
+        if args.serve_port:
+            serve_http(engine, args.serve_port, host=args.serve_host)
+    finally:
+        engine.shutdown()
+        metric_logger.close()
+    return engine

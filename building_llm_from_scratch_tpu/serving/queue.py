@@ -1,0 +1,66 @@
+"""Bounded FIFO request queue with explicit backpressure.
+
+The engine's admission control: ``put`` on a full queue either rejects
+immediately (``QueueFullError`` — the HTTP frontend turns this into a 429)
+or blocks until a slot retirement drains the queue (the JSONL batch
+frontend's backpressure). Deliberately NOT stdlib ``queue.Queue``: the
+scheduler needs non-destructive inspection (``peek``/depth) and the
+rejection path must be an exception the frontends can map to a status,
+not a sentinel.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Optional
+
+from building_llm_from_scratch_tpu.serving.request import Request
+
+
+class QueueFullError(Exception):
+    """The bounded request queue is at capacity (reject-over-capacity)."""
+
+
+class RequestQueue:
+    def __init__(self, max_size: int = 64):
+        if max_size < 1:
+            raise ValueError("max_size must be >= 1")
+        self.max_size = max_size
+        self._q: "collections.deque[Request]" = collections.deque()
+        self._lock = threading.Lock()
+        self._not_full = threading.Condition(self._lock)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._q)
+
+    def put(self, req: Request, block: bool = False,
+            timeout: Optional[float] = None) -> None:
+        """Enqueue FCFS; raises ``QueueFullError`` when at capacity (or
+        after ``timeout`` when ``block=True``)."""
+        with self._not_full:
+            if len(self._q) >= self.max_size:
+                if not block:
+                    raise QueueFullError(
+                        f"request queue full ({self.max_size})")
+                if not self._not_full.wait_for(
+                        lambda: len(self._q) < self.max_size,
+                        timeout=timeout):
+                    raise QueueFullError(
+                        f"request queue still full ({self.max_size}) "
+                        f"after {timeout}s")
+            self._q.append(req)
+
+    def get_nowait(self) -> Optional[Request]:
+        """Pop the oldest request, or None when empty."""
+        with self._not_full:
+            if not self._q:
+                return None
+            req = self._q.popleft()
+            self._not_full.notify()
+            return req
+
+    def peek(self) -> Optional[Request]:
+        with self._lock:
+            return self._q[0] if self._q else None

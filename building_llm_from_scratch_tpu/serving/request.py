@@ -1,0 +1,184 @@
+"""Request lifecycle for the serving engine.
+
+A ``Request`` is one generation job: prompt tokens + ``SamplingParams`` in,
+a stream of generated tokens out. The object doubles as the caller's
+handle — ``result()`` blocks until completion, ``stream()`` yields
+detokenized text pieces as the engine produces them — and carries the
+timestamps the serving telemetry is built from (queue wait, TTFT, TPOT).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue as _stdqueue
+import threading
+import time
+from typing import Any, Callable, Iterator, List, Optional
+
+#: request states
+QUEUED = "queued"
+RUNNING = "running"      # admitted to a slot (prefill or decode)
+FINISHED = "finished"
+REJECTED = "rejected"
+
+#: finish reasons
+FINISH_EOS = "eos"       # sampled the request's eos (token dropped)
+FINISH_LENGTH = "length"  # hit max_new_tokens
+FINISH_ERROR = "error"   # engine failure (req.error holds the message)
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    """Per-request decode controls (all co-batchable in one compiled
+    program — serving/engine.py samples with per-slot dynamic values).
+
+    ``seed`` pins the request's PRNG: token i is drawn with
+    ``generate.token_rng(PRNGKey(seed), i)`` regardless of slot placement
+    or co-batched traffic, so identical (prompt, seed, params) requests
+    reproduce — and match one-shot ``generate(rng=PRNGKey(seed))``.
+
+    ``eos_id=None`` means the engine's model default; ``ignore_eos=True``
+    disables eos stopping entirely (decode runs to the token budget).
+    """
+
+    max_new_tokens: int = 128
+    temperature: float = 0.0
+    top_k: Optional[int] = None
+    seed: int = 0
+    eos_id: Optional[int] = None
+    ignore_eos: bool = False
+
+
+class Request:
+    """One generation request + its result handle."""
+
+    def __init__(self, req_id: int, prompt_ids, params: SamplingParams,
+                 on_token: Optional[Callable[["Request", int, str], None]]
+                 = None):
+        self.id = req_id
+        self.prompt_ids = prompt_ids            # np.int32 (Tp,)
+        self.params = params
+        self.on_token = on_token
+        self.state = QUEUED
+        self.finish_reason: Optional[str] = None
+        self.output_ids: List[int] = []
+        self.text = ""
+        self._detok_start = 0    # first output_ids index not yet in text
+        self.slot: Optional[int] = None
+        self.error: Optional[str] = None
+        # timestamps (time.monotonic): submit -> admit (queue wait) ->
+        # first token (TTFT) -> finish (TPOT over the decode tail)
+        self.t_submit = time.monotonic()
+        self.t_admit: Optional[float] = None
+        self.t_first_token: Optional[float] = None
+        self.t_finish: Optional[float] = None
+        self._done = threading.Event()
+        self._stream: "_stdqueue.Queue[Optional[str]]" = _stdqueue.Queue()
+
+    # -- caller-side handle ----------------------------------------------
+
+    def result(self, timeout: Optional[float] = None) -> "Request":
+        """Block until the request finishes; returns self. Raises
+        ``RuntimeError`` if the engine failed the request (loop death)."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(f"request {self.id} not finished "
+                               f"within {timeout}s")
+        if self.error is not None:
+            raise RuntimeError(
+                f"request {self.id} failed: {self.error}")
+        return self
+
+    def stream(self, timeout: Optional[float] = None) -> Iterator[str]:
+        """Yield detokenized text pieces as they are generated (ends when
+        the request finishes). Raises ``TimeoutError`` — same as
+        ``result()`` — when no piece arrives within ``timeout``."""
+        while True:
+            try:
+                piece = self._stream.get(timeout=timeout)
+            except _stdqueue.Empty:
+                raise TimeoutError(
+                    f"request {self.id}: no stream piece within "
+                    f"{timeout}s") from None
+            if piece is None:
+                return
+            yield piece
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    # -- engine-side metrics ---------------------------------------------
+
+    def queue_wait_s(self) -> Optional[float]:
+        if self.t_admit is None:
+            return None
+        return self.t_admit - self.t_submit
+
+    def ttft_s(self) -> Optional[float]:
+        if self.t_first_token is None:
+            return None
+        return self.t_first_token - self.t_submit
+
+    def tpot_s(self) -> Optional[float]:
+        """Mean time per output token AFTER the first (None with < 2)."""
+        if (self.t_first_token is None or self.t_finish is None
+                or len(self.output_ids) < 2):
+            return None
+        return ((self.t_finish - self.t_first_token)
+                / (len(self.output_ids) - 1))
+
+    def e2e_s(self) -> Optional[float]:
+        if self.t_finish is None:
+            return None
+        return self.t_finish - self.t_submit
+
+    def summary(self) -> dict:
+        """The ``request_done`` telemetry payload."""
+        out: dict = {
+            "request_id": self.id,
+            "n_prompt_tokens": int(len(self.prompt_ids)),
+            "n_tokens": len(self.output_ids),
+            "finish_reason": self.finish_reason,
+            "slot": self.slot,
+        }
+        for name, fn in (("queue_wait_s", self.queue_wait_s),
+                         ("ttft_s", self.ttft_s), ("tpot_s", self.tpot_s),
+                         ("e2e_s", self.e2e_s)):
+            v = fn()
+            if v is not None:
+                out[name] = round(v, 6)
+        return out
+
+    # -- engine internals -------------------------------------------------
+
+    def _push_piece(self, piece: str) -> None:
+        self._stream.put(piece)
+
+    def _mark_done(self) -> None:
+        self._stream.put(None)
+        self._done.set()
+
+
+def resolve_eos(params: SamplingParams, default_eos: Optional[int]
+                ) -> Optional[int]:
+    """The eos id this request actually stops on (None = never)."""
+    if params.ignore_eos:
+        return None
+    return params.eos_id if params.eos_id is not None else default_eos
+
+
+_COUNTER = threading.Lock()
+_next_id = [0]
+
+
+def next_request_id() -> int:
+    with _COUNTER:
+        _next_id[0] += 1
+        return _next_id[0]
+
+
+__all__: List[Any] = [
+    "QUEUED", "RUNNING", "FINISHED", "REJECTED",
+    "FINISH_EOS", "FINISH_LENGTH", "FINISH_ERROR",
+    "SamplingParams", "Request", "resolve_eos", "next_request_id",
+]

@@ -14,8 +14,8 @@ if command -v ruff >/dev/null 2>&1; then
     # obs/ + scripts are held to the full pyflakes ruleset (see the
     # [tool.ruff.lint] comment in pyproject.toml: ruff has no per-file
     # `select`, so the widened scope is this second invocation)
-    ruff check --extend-select F building_llm_from_scratch_tpu/obs scripts \
-        || exit 1
+    ruff check --extend-select F building_llm_from_scratch_tpu/obs \
+        building_llm_from_scratch_tpu/serving scripts || exit 1
 else
     echo "== ruff not installed; skipping lint =="
 fi
@@ -66,6 +66,45 @@ stalls = sum(r.get("prefetch_stall", 0) for r in rows
 assert stalls == 0, f"prefetch stalled {stalls}x on the smoke workload"
 print(f"overlap smoke ok: {trainer.global_step} steps, "
       f"{len(async_saves)} async saves, 0 prefetch stalls")
+EOF
+
+echo "== serving smoke (continuous-batching engine, CPU) =="
+JAX_PLATFORMS=cpu python - <<'EOF' || exit 1
+import json, os, tempfile
+d = tempfile.mkdtemp()
+# 8 concurrent JSONL requests against the tiny --debug GPT-2 (ctx 16):
+# short byte prompts + small budgets fit the slot capacity
+reqs = os.path.join(d, "requests.jsonl")
+with open(reqs, "w") as f:
+    for i in range(8):
+        f.write(json.dumps({"prompt": "abcd"[: 1 + i % 4],
+                            "max_new_tokens": 4 + i % 4,
+                            "temperature": 0.8 if i % 2 else 0.0,
+                            "top_k": 8 if i % 2 else None,
+                            "seed": i}) + "\n")
+out = os.path.join(d, "results.jsonl")
+mj = os.path.join(d, "metrics.jsonl")
+from building_llm_from_scratch_tpu.args import get_args
+from building_llm_from_scratch_tpu.main import main
+engine = main(get_args([
+    "--mode", "serve", "--debug", "--byte_tokenizer",
+    "--data_dir", d,                      # unused in serve mode
+    "--serve_prompts", reqs, "--serve_out", out,
+    "--serve_slots", "4", "--serve_max_queue", "8",
+    "--metrics_jsonl", mj,
+]))
+results = [json.loads(l) for l in open(out)]
+assert len(results) == 8, f"expected 8 results, got {len(results)}"
+assert all(r["finish_reason"] in ("eos", "length") for r in results), results
+rows = [json.loads(l) for l in open(mj)]
+done = [r for r in rows if r.get("event") == "request_done"]
+assert len(done) >= 1, "no request_done event in the JSONL"
+recompiles = [r for r in rows if r.get("event") == "recompile"]
+assert not recompiles, f"recompile after warmup: {recompiles}"
+assert engine.n_recompiles == 0
+print(f"serving smoke ok: {len(results)} requests, "
+      f"{sum(r['n_tokens'] for r in results)} tokens, "
+      f"{len(done)} request_done events, 0 recompiles")
 EOF
 
 echo "== tier-1 tests (ROADMAP.md) =="

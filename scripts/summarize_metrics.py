@@ -8,7 +8,9 @@ artifact, this is just one renderer over it.
 Prints the run header, per-event-kind counts, final/peak numbers, the
 per-layer-group grad-norm trajectory (``health`` rows), the compile
 telemetry (compile seconds, HLO FLOPs, HLO-vs-analytic MFU delta,
-recompiles) and the HBM budget breakdown to stdout; writes a 2x2 figure
+recompiles), the serving section (per-request latency percentiles, slot
+occupancy, queue depth — ``--mode serve`` runs) and the HBM budget
+breakdown to stdout; writes a 2x2 figure
 (train/val loss, tok/s, MFU, memory) when matplotlib is available (text
 summary still works without it).
 """
@@ -128,6 +130,63 @@ def summarize_overlap(metrics, events):
 
 def _fmt_bytes(n):
     return f"{n / 1024**2:.1f} MiB" if n < 1024**3 else f"{n / 1024**3:.2f} GiB"
+
+
+def _pctile(values, p):
+    """Nearest-rank percentile (no numpy dependency for the renderer)."""
+    vals = sorted(values)
+    if not vals:
+        return None
+    k = max(0, min(len(vals) - 1, round(p / 100 * (len(vals) - 1))))
+    return vals[k]
+
+
+def summarize_serving(metrics, events):
+    """Serving section: per-request latency percentiles (queue wait, TTFT,
+    TPOT, end-to-end) from ``request_done`` events, finish-reason and
+    rejection counts, slot occupancy and queue depth from the engine's
+    metric rows, and the decode token rate."""
+    done = [e for e in events if e["event"] == "request_done"]
+    rejected = [e for e in events if e["event"] == "request_rejected"]
+    if not (done or rejected):
+        return
+    print("\n-- serving --")
+    reasons = {}
+    for e in done:
+        reasons[e.get("finish_reason")] = reasons.get(
+            e.get("finish_reason"), 0) + 1
+    total_tok = sum(e.get("n_tokens", 0) for e in done)
+    print(f"  {len(done)} requests done ({total_tok} tokens; "
+          + ", ".join(f"{k} x{v}" for k, v in sorted(reasons.items()))
+          + (f"; {len(rejected)} REJECTED over capacity" if rejected
+             else "") + ")")
+    for key, label in (("queue_wait_s", "queue wait"), ("ttft_s", "TTFT"),
+                       ("tpot_s", "TPOT"), ("e2e_s", "end-to-end")):
+        vals = [e[key] for e in done
+                if isinstance(e.get(key), (int, float))]
+        if vals:
+            print(f"  {label:<12} p50 {1e3 * _pctile(vals, 50):8.2f} ms   "
+                  f"p95 {1e3 * _pctile(vals, 95):8.2f} ms   "
+                  f"p99 {1e3 * _pctile(vals, 99):8.2f} ms")
+    occ = [r["slot_occupancy"] for r in metrics
+           if isinstance(r.get("slot_occupancy"), (int, float))]
+    if occ:
+        print(f"  slot occupancy: mean {sum(occ) / len(occ):.2f}, "
+              f"min {min(occ):.2f} (idle slots = unused compute — "
+              "lower --serve_slots or raise offered load)")
+    depth = [r["queue_depth"] for r in metrics
+             if isinstance(r.get("queue_depth"), (int, float))]
+    if depth:
+        print(f"  queue depth: peak {int(max(depth))}")
+    _, rate = column(metrics, "serve_tok_s")
+    if rate:
+        print(f"  decode rate: last {rate[-1]:.0f} tok/s, "
+              f"peak {max(rate):.0f} tok/s")
+    summaries = [e for e in events if e["event"] == "serve_summary"]
+    if summaries and summaries[-1].get("n_recompiles"):
+        print(f"  !! {summaries[-1]['n_recompiles']} RECOMPILES after "
+              "warmup — prompt lengths outside the warmed bucket set "
+              "(see the recompile events' leaf diffs)")
 
 
 def summarize_compile(metrics, events):
@@ -291,6 +350,7 @@ def main(argv=None):
     header, metrics, events, health = load_rows(args.jsonl)
     summarize(header, metrics, events)
     summarize_compile(metrics, events)
+    summarize_serving(metrics, events)
     summarize_health(health)
     if metrics:
         out = args.out or os.path.join(

@@ -54,6 +54,38 @@ def test_fused_decode_step_matches_jnp_path(B, Hq, Hkv, hd, Tmax, t):
                                atol=2e-2, rtol=2e-2)
 
 
+@needs_tpu
+def test_fused_decode_step_per_row_lengths():
+    """Per-row lengths (the serving engine's slot batch, ops/decode_step
+    slot semantics): each row appends at ITS offset and attends its own
+    valid prefix — must match running each row alone at a scalar length."""
+    from building_llm_from_scratch_tpu.ops.decode_step import (
+        fused_decode_step,
+    )
+
+    B, Hq, Hkv, hd, Tmax = 3, 12, 12, 64, 320
+    ks = jax.random.split(jax.random.PRNGKey(7), 5)
+    q = jax.random.normal(ks[0], (B, 1, Hq, hd), jnp.bfloat16)
+    kn = jax.random.normal(ks[1], (B, 1, Hkv, hd), jnp.bfloat16)
+    vn = jax.random.normal(ks[2], (B, 1, Hkv, hd), jnp.bfloat16)
+    K = jax.random.normal(ks[3], (B, Hkv, Tmax, hd), jnp.bfloat16)
+    V = jax.random.normal(ks[4], (B, Hkv, Tmax, hd), jnp.bfloat16)
+    lengths = jnp.asarray([0, 7, 133], jnp.int32)
+
+    out, Ko, Vo = jax.jit(fused_decode_step)(q, kn, vn, K, V, lengths)
+    for b in range(B):
+        ob, Kb, Vb = jax.jit(fused_decode_step)(
+            q[b:b + 1], kn[b:b + 1], vn[b:b + 1], K[b:b + 1], V[b:b + 1],
+            lengths[b])
+        np.testing.assert_allclose(np.asarray(Ko[b:b + 1], np.float32),
+                                   np.asarray(Kb, np.float32))
+        np.testing.assert_allclose(np.asarray(Vo[b:b + 1], np.float32),
+                                   np.asarray(Vb, np.float32))
+        np.testing.assert_allclose(np.asarray(out[b:b + 1], np.float32),
+                                   np.asarray(ob, np.float32),
+                                   atol=2e-2, rtol=2e-2)
+
+
 def test_decode_step_supports_shape_gates():
     from building_llm_from_scratch_tpu.ops.decode_step import supports_shape
 

@@ -1,0 +1,475 @@
+"""Serving subsystem tests (serving/): engine correctness — slot reuse,
+engine-vs-generate() token parity, mid-stream admission isolation, queue
+backpressure, eos/max-token retirement, per-request RNG reproducibility,
+zero-recompile discipline — plus the generate() per-row eos satellite and
+the ops-level slot primitives they sit on.
+"""
+
+import http.client
+import json
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from building_llm_from_scratch_tpu.configs import ModelConfig
+from building_llm_from_scratch_tpu.generate import generate
+from building_llm_from_scratch_tpu.models import init_params
+from building_llm_from_scratch_tpu.serving import (
+    DecodeEngine,
+    QueueFullError,
+    RequestQueue,
+    SamplingParams,
+    Scheduler,
+)
+from building_llm_from_scratch_tpu.serving.request import Request
+
+
+def tiny_cfg(ctx=64, **kw):
+    base = dict(name="serve-tiny", vocab_size=96, context_length=ctx,
+                emb_dim=32, n_heads=2, n_layers=2, hidden_dim=64,
+                n_kv_groups=2, norm="layernorm", positional="learned",
+                activation="gelu", drop_rate=0.0, eos_id=1)
+    base.update(kw)
+    return ModelConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_cfg()
+    return cfg, init_params(cfg, jax.random.PRNGKey(0))
+
+
+def solo_tokens(params, cfg, prompt, sp: SamplingParams):
+    """The engine's expected output for one request: one-shot generate()
+    with the matching seed/params (shared rng derivation + sampling)."""
+    out, n = generate(params, cfg, np.asarray(prompt)[None],
+                      max_new_tokens=sp.max_new_tokens,
+                      temperature=sp.temperature, top_k=sp.top_k,
+                      eos_id=(None if sp.ignore_eos
+                              else (sp.eos_id if sp.eos_id is not None
+                                    else cfg.eos_id)),
+                      rng=jax.random.PRNGKey(sp.seed),
+                      return_n_generated=True)
+    Tp = len(prompt)
+    return [int(t) for t in out[0, Tp: Tp + int(n[0])]]
+
+
+# ---------------------------------------------------------------------------
+# ops-level slot primitives
+# ---------------------------------------------------------------------------
+
+def test_slot_cache_append_per_row_offsets():
+    from building_llm_from_scratch_tpu.ops.decode_step import (
+        slot_cache_append,
+    )
+
+    S, H, T, D = 3, 2, 8, 4
+    cache = np.zeros((S, H, T, D), np.float32)
+    new = np.arange(S * H * D, dtype=np.float32).reshape(S, H, 1, D)
+    lengths = np.array([0, 3, 7], np.int32)
+    out = np.asarray(slot_cache_append(jnp.asarray(cache),
+                                       jnp.asarray(new), lengths))
+    for s, t in enumerate(lengths):
+        np.testing.assert_array_equal(out[s, :, t], new[s, :, 0])
+        mask = np.ones(T, bool)
+        mask[t] = False
+        assert (out[s][:, mask] == 0).all()
+    # scalar length must equal the shared-offset DUS the decode path uses
+    shared = np.asarray(slot_cache_append(jnp.asarray(cache),
+                                          jnp.asarray(new),
+                                          jnp.asarray(2, jnp.int32)))
+    np.testing.assert_array_equal(shared[:, :, 2], new[:, :, 0])
+
+
+def test_decode_attention_per_row_matches_scalar():
+    from building_llm_from_scratch_tpu.ops.attention import decode_attention
+
+    B, Hq, Hkv, D, T = 2, 4, 2, 8, 16
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    q = jax.random.normal(ks[0], (B, 1, Hq, D))
+    K = jax.random.normal(ks[1], (B, Hkv, T, D))
+    V = jax.random.normal(ks[2], (B, Hkv, T, D))
+    # all rows at the same length: the per-row path must equal the scalar
+    scalar = decode_attention(q, K, V, q_positions=jnp.asarray([5]),
+                              kv_length=jnp.asarray(6))
+    perrow = decode_attention(
+        q, K, V, q_positions=jnp.full((B, 1), 5),
+        kv_length=jnp.full((B,), 6))
+    np.testing.assert_allclose(np.asarray(scalar), np.asarray(perrow),
+                               rtol=1e-6)
+    # different per-row lengths: each row must match its own scalar run
+    lens = jnp.asarray([3, 9])
+    mixed = decode_attention(q, K, V,
+                             q_positions=(lens - 1)[:, None],
+                             kv_length=lens)
+    for b in range(B):
+        ref = decode_attention(q[b:b + 1], K[b:b + 1], V[b:b + 1],
+                               q_positions=(lens[b] - 1)[None],
+                               kv_length=lens[b])
+        np.testing.assert_allclose(np.asarray(mixed[b]),
+                                   np.asarray(ref[0]), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# generate(): per-row eos satellite
+# ---------------------------------------------------------------------------
+
+def test_generate_per_row_eos_stops_one_row_not_the_other(model):
+    cfg, params = model
+    r0 = np.asarray(jax.random.randint(jax.random.PRNGKey(5), (1, 4), 2,
+                                       cfg.vocab_size), np.int32)
+    r1 = np.asarray(jax.random.randint(jax.random.PRNGKey(6), (1, 4), 2,
+                                       cfg.vocab_size), np.int32)
+    prompt = np.concatenate([r0, r1], 0)
+    probe = generate(params, cfg, prompt, max_new_tokens=1)
+    first = np.asarray(probe)[:, -1]
+    if first[0] == first[1]:
+        pytest.skip("rows greedily agree on token 0; cannot split")
+    eos = int(first[0])
+    out, n = generate(params, cfg, prompt, max_new_tokens=4, eos_id=eos,
+                      return_n_generated=True)
+    # row 0 sampled its eos first — stopped, token dropped, padded w/ eos
+    assert n[0] == 0
+    assert n[1] >= 1
+    assert out.shape[1] == prompt.shape[1] + int(n.max())
+    if n[1] > 0:
+        assert (out[0, prompt.shape[1]:] == eos).all()
+    # escape hatch: the reference's batch-global quirk — row 0's eos
+    # neither stops it nor is dropped
+    ref = generate(params, cfg, prompt, max_new_tokens=4, eos_id=eos,
+                   ref_eos_semantics=True)
+    assert ref.shape[1] == prompt.shape[1] + 4
+    assert ref[0, prompt.shape[1]] == eos
+
+
+# ---------------------------------------------------------------------------
+# engine correctness
+# ---------------------------------------------------------------------------
+
+def test_engine_matches_generate_greedy_and_sampled(model):
+    """Token-level engine-vs-generate() parity for a greedy and a seeded
+    sampling request decoded CONCURRENTLY in one slot batch."""
+    cfg, params = model
+    eng = DecodeEngine(cfg, params, n_slots=3, max_len=64)
+    prompt = np.array([5, 6, 7, 8, 9], np.int32)
+    cases = [
+        SamplingParams(max_new_tokens=8, seed=3),
+        SamplingParams(max_new_tokens=8, temperature=1.0, top_k=5, seed=3),
+        SamplingParams(max_new_tokens=6, temperature=0.7, top_k=13,
+                       seed=11),
+    ]
+    handles = [eng.submit(prompt, sp) for sp in cases]
+    eng.run_until_idle()
+    for h, sp in zip(handles, cases):
+        assert h.done and h.finish_reason in ("eos", "length")
+        assert h.output_ids == solo_tokens(params, cfg, prompt, sp), sp
+
+
+def test_slot_reuse_and_seed_reproducibility(model):
+    """More requests than slots: retired slots are reused and every
+    request still matches its solo run — including two identical
+    (prompt, seed) requests submitted amid different co-batched traffic,
+    which must produce identical tokens regardless of slot placement."""
+    cfg, params = model
+    eng = DecodeEngine(cfg, params, n_slots=2, max_len=64, max_queue=16)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(2, cfg.vocab_size, (3 + i,)).astype(np.int32)
+               for i in range(5)]
+    sps = [SamplingParams(max_new_tokens=4 + i, seed=i,
+                          temperature=0.5 * (i % 2), top_k=7 if i % 2
+                          else None)
+           for i in range(5)]
+    twin = (np.array([4, 4, 4], np.int32),
+            SamplingParams(max_new_tokens=5, temperature=1.0, top_k=9,
+                           seed=42))
+    handles = [eng.submit(p, sp) for p, sp in zip(prompts, sps)]
+    h_twin1 = eng.submit(twin[0], twin[1])
+    eng.run_until_idle()
+    # resubmit the twin amid fresh traffic: different slot history, same
+    # tokens
+    h_more = [eng.submit(p, sp) for p, sp in zip(prompts[:2], sps[:2])]
+    h_twin2 = eng.submit(twin[0], twin[1])
+    eng.run_until_idle()
+    for h, p, sp in zip(handles + h_more, list(prompts) + prompts[:2],
+                        sps + sps[:2]):
+        assert h.output_ids == solo_tokens(params, cfg, p, sp)
+    assert h_twin1.output_ids == h_twin2.output_ids
+    assert h_twin1.output_ids == solo_tokens(params, cfg, *twin)
+    assert eng.scheduler.n_active == 0 and len(eng.queue) == 0
+
+
+def test_midstream_admission_does_not_perturb_inflight(model):
+    """Admitting B while A is mid-decode must not change A's tokens."""
+    cfg, params = model
+    eng = DecodeEngine(cfg, params, n_slots=2, max_len=64)
+    pa = np.array([9, 8, 7, 6], np.int32)
+    pb = np.array([3, 4, 5], np.int32)
+    sa = SamplingParams(max_new_tokens=10, seed=1, temperature=1.0,
+                        top_k=11)
+    ha = eng.submit(pa, sa)
+    for _ in range(3):                       # A decodes alone for a while
+        assert eng.step()
+    assert not ha.done
+    hb = eng.submit(pb, SamplingParams(max_new_tokens=6, seed=2))
+    eng.run_until_idle()
+    assert ha.output_ids == solo_tokens(params, cfg, pa, sa)
+    assert hb.output_ids == solo_tokens(params, cfg, pb, hb.params)
+
+
+def test_queue_backpressure_reject(model):
+    cfg, params = model
+    eng = DecodeEngine(cfg, params, n_slots=1, max_len=64, max_queue=2)
+    sp = SamplingParams(max_new_tokens=2)
+    p = np.array([2, 3], np.int32)
+    h1, h2 = eng.submit(p, sp), eng.submit(p, sp)
+    with pytest.raises(QueueFullError):
+        eng.submit(p, sp)                      # bounded queue: reject
+    assert eng.requests_rejected == 1
+    eng.run_until_idle()
+    assert h1.done and h2.done
+    eng.submit(p, sp)                          # space again after drain
+    eng.run_until_idle()
+
+
+def test_eos_and_max_token_retirement(model):
+    cfg, params = model
+    prompt = np.array([7, 7, 8], np.int32)
+    probe = generate(params, cfg, prompt[None], max_new_tokens=1)
+    t0 = int(np.asarray(probe)[0, -1])         # the first greedy token
+    eng = DecodeEngine(cfg, params, n_slots=2, max_len=64)
+    # greedy request whose eos IS its first sampled token: finishes at
+    # admission with zero output tokens, reason 'eos', slot freed
+    h_eos = eng.submit(prompt, SamplingParams(max_new_tokens=5, eos_id=t0))
+    h_len = eng.submit(prompt, SamplingParams(max_new_tokens=4,
+                                              ignore_eos=True))
+    eng.run_until_idle()
+    assert h_eos.finish_reason == "eos" and h_eos.output_ids == []
+    assert h_len.finish_reason == "length" and len(h_len.output_ids) == 4
+    assert eng.scheduler.n_active == 0
+
+
+def test_finish_during_admission_does_not_strand_queue(model):
+    """Every request finishes DURING admission (eos is its first sampled
+    token): step() must keep refilling the freed slot from the queue in
+    the same tick instead of reporting idle with requests still queued."""
+    cfg, params = model
+    prompt = np.array([7, 7, 8], np.int32)
+    probe = generate(params, cfg, prompt[None], max_new_tokens=1)
+    t0 = int(np.asarray(probe)[0, -1])
+    eng = DecodeEngine(cfg, params, n_slots=1, max_len=64, max_queue=8)
+    handles = [eng.submit(prompt, SamplingParams(max_new_tokens=5,
+                                                 eos_id=t0))
+               for _ in range(3)]
+    eng.run_until_idle()
+    for h in handles:
+        assert h.done and h.finish_reason == "eos" and h.output_ids == []
+    assert eng.scheduler.n_active == 0 and len(eng.queue) == 0
+
+
+def test_engine_loop_death_fails_requests_instead_of_hanging(model):
+    """An exception escaping step() on the background thread (here: a
+    raising on_token callback) must fail the in-flight AND queued requests
+    — result() raises, shutdown() returns — not strand them forever."""
+    cfg, params = model
+    eng = DecodeEngine(cfg, params, n_slots=1, max_len=64, max_queue=8)
+
+    def bad_callback(req, tok, piece):
+        raise RuntimeError("boom from user callback")
+
+    sp = SamplingParams(max_new_tokens=4, ignore_eos=True)
+    p = np.array([2, 3, 4], np.int32)
+    h_bad = eng.submit(p, sp, on_token=bad_callback)
+    h_queued = eng.submit(p, sp)
+    eng.start()
+    with pytest.raises(RuntimeError, match="engine loop error"):
+        h_bad.result(timeout=30)
+    with pytest.raises(RuntimeError, match="engine loop error"):
+        h_queued.result(timeout=30)
+    assert h_bad.finish_reason == "error" and h_bad.error
+    # a dead engine rejects new submissions instead of silently
+    # enqueueing them into a loop that will never run again
+    with pytest.raises(RuntimeError, match="engine is dead"):
+        eng.submit(p, sp)
+    eng.shutdown()                             # must not spin forever
+    assert eng.scheduler.n_active == 0 and len(eng.queue) == 0
+
+
+def test_top_k_over_compiled_capacity_rejected(model):
+    cfg, params = model
+    eng = DecodeEngine(cfg, params, n_slots=1, max_len=64, max_top_k=8)
+    with pytest.raises(ValueError, match="top_k"):
+        eng.submit(np.array([2, 3], np.int32),
+                   SamplingParams(max_new_tokens=2, top_k=9))
+
+
+def test_terminal_bucket_warmed_when_max_len_not_multiple_of_64(model):
+    """max_len=48: the clamped terminal bucket (48) must be in the warmup
+    set, so a fully in-capacity prompt (40 tokens) never fires a
+    bucket-miss recompile after freeze."""
+    cfg, params = model
+    eng = DecodeEngine(cfg, params, n_slots=1, max_len=48)
+    assert 48 in eng.prompt_buckets()
+    eng.warmup()
+    h = eng.submit(np.full((40,), 5, np.int32),
+                   SamplingParams(max_new_tokens=3, ignore_eos=True))
+    eng.run_until_idle()
+    assert len(h.output_ids) == 3
+    assert eng.n_recompiles == 0
+
+
+def test_streaming_and_callbacks(model):
+    cfg, params = model
+    from building_llm_from_scratch_tpu.data.tokenizers import ByteTokenizer
+
+    tok = ByteTokenizer()
+    eng = DecodeEngine(cfg, params, tokenizer=tok, n_slots=1, max_len=64)
+    seen = []
+    h = eng.submit("abc", SamplingParams(max_new_tokens=5,
+                                         ignore_eos=True),
+                   on_token=lambda r, t, piece: seen.append((t, piece)))
+    eng.run_until_idle()
+    pieces = list(h.stream(timeout=1))
+    assert len(h.output_ids) == 5
+    assert len(seen) == 5
+    assert [t for t, _ in seen] == h.output_ids
+    assert "".join(pieces) == h.text
+    assert h.text == tok.decode(h.output_ids)
+
+
+def test_incremental_detok_holds_partial_multibyte(model):
+    """A token that is the first byte of a multi-byte UTF-8 char must be
+    held (empty piece), then emitted as ONE complete char when the
+    continuation byte arrives — not committed as a mangled replacement
+    char; final flush emits whatever is left."""
+    cfg, params = model
+    from building_llm_from_scratch_tpu.data.tokenizers import ByteTokenizer
+
+    eng = DecodeEngine(cfg, params, tokenizer=ByteTokenizer(), n_slots=1,
+                       max_len=64)
+    req = Request(9001, np.array([1], np.int32), SamplingParams())
+    req.output_ids.append(0xC3)                # first byte of 'é'
+    assert eng._detok_piece(req) == "" and req.text == ""
+    req.output_ids.append(0xA9)                # continuation byte
+    assert eng._detok_piece(req) == "é" and req.text == "é"
+    req.output_ids.append(ord("x"))
+    assert eng._detok_piece(req) == "x"
+    req.output_ids.append(0xC3)                # dangling partial at finish
+    assert eng._detok_piece(req) == ""
+    assert eng._detok_piece(req, final=True) == "�"
+    assert req.text == "éx�"
+    assert req.text == ByteTokenizer().decode(req.output_ids[:-1]) + "�"
+
+
+def test_zero_recompiles_after_warmup_and_bucket_miss_surfaces(model,
+                                                               tmp_path):
+    """The compile discipline the smoke gate enforces: warmup compiles the
+    bucket set, in-bucket traffic never recompiles, and an out-of-bucket
+    prompt fires a ``recompile`` event (the bucket-miss detector)."""
+    from building_llm_from_scratch_tpu.obs.metrics import configure_metrics
+
+    cfg = tiny_cfg(ctx=192)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    mj = str(tmp_path / "serve_metrics.jsonl")
+    sink = configure_metrics(mj)
+    sink.write_header(test="recompile")
+    try:
+        eng = DecodeEngine(cfg, params, n_slots=2, max_len=192,
+                           warmup_prompt_cap=64)
+        eng.warmup()
+        assert eng.warmed_up
+        # in-bucket traffic (prompt bucket 64): silent steady state
+        h = eng.submit(np.arange(2, 12, dtype=np.int32),
+                       SamplingParams(max_new_tokens=3, ignore_eos=True))
+        eng.run_until_idle()
+        assert len(h.output_ids) == 3
+        assert eng.n_recompiles == 0
+        # a 70-token prompt needs the UNWARMED 128 bucket: recompile event
+        h2 = eng.submit(np.full((70,), 5, np.int32),
+                        SamplingParams(max_new_tokens=2, ignore_eos=True))
+        eng.run_until_idle()
+        assert len(h2.output_ids) == 2
+        assert eng.n_recompiles == 1
+    finally:
+        sink.close()
+        configure_metrics(None)
+    rows = [json.loads(line) for line in open(mj)]
+    recompiles = [r for r in rows if r.get("event") == "recompile"]
+    assert len(recompiles) == 1
+    assert recompiles[0]["label"] == "serve_prefill"
+    assert [r for r in rows if r.get("event") == "request_done"]
+    assert [r for r in rows if r.get("event") == "serve_warmup"]
+
+
+def test_http_frontend_generate_and_healthz(model):
+    cfg, params = model
+    from building_llm_from_scratch_tpu.serving.frontend import (
+        make_http_server,
+    )
+
+    eng = DecodeEngine(cfg, params, n_slots=1, max_len=64)
+    eng.start()
+    server = make_http_server(eng, 0, host="127.0.0.1")
+    port = server.server_address[1]
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        conn.request("GET", "/healthz")
+        health = json.loads(conn.getresponse().read())
+        assert health["slots"] == 1 and health["queue_capacity"] >= 1
+        body = json.dumps({"prompt_ids": [5, 6, 7], "max_new_tokens": 3,
+                           "ignore_eos": True, "seed": 4})
+        conn.request("POST", "/generate", body=body,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        out = json.loads(resp.read())
+        assert resp.status == 200, out
+        assert len(out["token_ids"]) == 3
+        assert out["finish_reason"] == "length"
+        conn.close()
+    finally:
+        server.shutdown()
+        server.server_close()
+        eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# scheduler / queue units (no jax)
+# ---------------------------------------------------------------------------
+
+def _dummy_req(i):
+    return Request(1000 + i, np.array([1], np.int32), SamplingParams())
+
+
+def test_scheduler_fcfs_admission_and_slot_reuse():
+    q = RequestQueue(8)
+    sched = Scheduler(2)
+    reqs = [_dummy_req(i) for i in range(4)]
+    for r in reqs:
+        q.put(r)
+    admitted = sched.admit_from(q)
+    assert [(s, r.id) for s, r in admitted] == [(0, 1000), (1, 1001)]
+    assert sched.n_active == 2 and sched.admit_from(q) == []
+    sched.retire(0)
+    # freed slot refills FCFS from the queue head
+    assert [(s, r.id) for s, r in sched.admit_from(q)] == [(0, 1002)]
+    with pytest.raises(ValueError):
+        sched.retire(1) or sched.retire(1)
+    sched.retire(0)
+    assert [(s, r.id) for s, r in sched.admit_from(q)] == [(0, 1003)]
+    assert sched.occupancy() == 0.5            # 1003 alone; 1001 retired
+
+
+def test_request_queue_block_timeout_and_capacity():
+    q = RequestQueue(1)
+    q.put(_dummy_req(0))
+    with pytest.raises(QueueFullError):
+        q.put(_dummy_req(1))
+    with pytest.raises(QueueFullError):
+        q.put(_dummy_req(1), block=True, timeout=0.05)
+    assert q.get_nowait().id == 1000
+    q.put(_dummy_req(2))                      # capacity restored
+    assert len(q) == 1
