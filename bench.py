@@ -503,6 +503,119 @@ def bench_serve(n_requests=8, max_new=32, prompt_len=16):
             f"{max_new}new continuous-batching slots4", engine_at_4)
 
 
+def bench_serve_load(n_slots=4, max_new=24, prompt_len=16,
+                     n_requests=40, deadline_factor=2.0):
+    """Open-loop Poisson-arrival load sweep (the load-harness seed for
+    the scale-out serving roadmap item): requests arrive on a Poisson
+    schedule regardless of completions — unlike the closed-loop
+    ``bench.py serve`` arm, this can actually SEE saturation, because
+    offered load keeps coming when the engine falls behind.
+
+    Arms sweep offered load at 0.5x / 1.0x / 1.5x the engine's measured
+    closed-loop capacity. Every request carries a deadline
+    (``deadline_factor`` x its ideal solo service time), so the overload
+    arm exercises the real admission stack: SLO shedding at submit,
+    TTL expiry in the queue, 429-style queue-full rejection. Reported
+    per arm: offered/completed rps, shed/expired/rejected counts, and
+    TTFT/TPOT/e2e percentiles — the latency-vs-throughput curve.
+
+    fp32 on CPU, bf16 on TPU (same policy as ``bench_serve``)."""
+    import time
+
+    from building_llm_from_scratch_tpu.configs import get_config
+    from building_llm_from_scratch_tpu.generate import _bucket
+    from building_llm_from_scratch_tpu.models import init_params
+    from building_llm_from_scratch_tpu.serving import (
+        DecodeEngine,
+        QueueFullError,
+        SLOShedError,
+        SamplingParams,
+    )
+    from building_llm_from_scratch_tpu.serving.request import (
+        RequestExpiredError,
+    )
+
+    dtype = "bf16" if jax.default_backend() == "tpu" else "fp32"
+    cfg = get_config("GPT2", "124M", dtype=dtype)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(0, cfg.vocab_size,
+                           (n_requests, prompt_len)).astype(np.int32)
+
+    def new_engine():
+        eng = DecodeEngine(cfg, params, n_slots=n_slots,
+                           max_len=_bucket(prompt_len + max_new),
+                           max_queue=max(2 * n_slots, 16),
+                           warmup_prompt_cap=prompt_len)
+        eng.warmup()
+        return eng
+
+    # measure closed-loop capacity first: n_slots requests decoded flat out
+    eng = new_engine()
+    t0 = time.perf_counter()
+    sp = SamplingParams(max_new_tokens=max_new, ignore_eos=True)
+    handles = [eng.submit(p, sp, block=True) for p in prompts[:n_slots]]
+    eng.run_until_idle()
+    cap_tok_s = n_slots * max_new / (time.perf_counter() - t0)
+    cap_rps = cap_tok_s / max_new            # requests/sec at saturation
+    solo_s = max_new / (cap_tok_s / n_slots)  # ideal one-request service
+    eng.shutdown()
+    detail = {"capacity": {"tok_s": round(cap_tok_s, 1),
+                           "rps": round(cap_rps, 3)}}
+
+    deadline_s = deadline_factor * solo_s
+    completed_at_1x = 0.0
+    for load in (0.5, 1.0, 1.5):
+        lam = load * cap_rps                 # offered arrival rate
+        arrivals = np.cumsum(rng.exponential(1.0 / lam, n_requests))
+        eng = new_engine()
+        eng.start()
+        handles, shed, rejected = [], 0, 0
+        t0 = time.perf_counter()
+        for i, (p, at) in enumerate(zip(prompts, arrivals)):
+            delay = at - (time.perf_counter() - t0)
+            if delay > 0:
+                time.sleep(delay)            # open loop: arrivals wait
+            try:                             # for the CLOCK, not the engine
+                handles.append(eng.submit(p, SamplingParams(
+                    max_new_tokens=max_new, ignore_eos=True,
+                    deadline_s=deadline_s, seed=i)))
+            except SLOShedError:
+                shed += 1
+            except QueueFullError:
+                rejected += 1
+        done, expired = 0, 0
+        for h in handles:
+            try:
+                h.result(timeout=120)
+                done += 1
+            except RequestExpiredError:
+                expired += 1
+            except RuntimeError:
+                pass
+        dt = time.perf_counter() - t0
+        eng.shutdown()
+        stats = eng.stats()
+        arm = {
+            "offered_rps": round(lam, 3),
+            "completed_rps": round(done / dt, 3),
+            "done": done, "shed": shed, "expired": expired,
+            "rejected": rejected,
+            "shed_rate": round((shed + expired + rejected)
+                               / n_requests, 3),
+        }
+        for key in ("ttft_s", "tpot_s", "e2e_s"):
+            if key in stats:
+                arm[key] = stats[key]
+        detail[f"load_{load:g}x"] = arm
+        if load == 1.0:
+            completed_at_1x = done / dt
+    print(json.dumps(detail), flush=True)
+    return (f"serve offered-load sweep GPT2-124M {dtype} {n_requests}req "
+            f"poisson slots{n_slots} completed-rps@1.0x",
+            completed_at_1x * max_new)
+
+
 BENCHES = {
     "headline": bench_headline,
     "cfg1": bench_cfg1,
@@ -515,6 +628,7 @@ BENCHES = {
     "prefetch": bench_prefetch,
     "decode": bench_decode,
     "serve": bench_serve,
+    "serve_load": bench_serve_load,
 }
 
 

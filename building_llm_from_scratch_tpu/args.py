@@ -84,6 +84,16 @@ def perform_checks(args) -> None:
         if args.serve_max_len < 0:
             raise ValueError("--serve_max_len must be >= 0 (0 = model "
                              "context length).")
+        if args.drain_timeout <= 0:
+            raise ValueError("--drain_timeout must be > 0 seconds.")
+        if args.serve_tick_timeout < 0:
+            raise ValueError("--serve_tick_timeout must be >= 0 "
+                             "(0 disables the supervisor).")
+        if args.serve_max_restarts < 0:
+            raise ValueError("--serve_max_restarts must be >= 0.")
+        if args.serve_deadline_s < 0:
+            raise ValueError("--serve_deadline_s must be >= 0 "
+                             "(0 = no default deadline).")
     else:
         # every serve flag, not just the workload pair: a non-default
         # value outside serve mode is a mistyped/missing --mode serve,
@@ -93,7 +103,9 @@ def perform_checks(args) -> None:
             ("serve_out", None), ("serve_slots", 8),
             ("serve_max_queue", 64), ("serve_max_new_tokens", 128),
             ("serve_max_len", 0), ("serve_max_top_k", 64),
-            ("serve_host", "127.0.0.1"),
+            ("serve_host", "127.0.0.1"), ("drain_timeout", 30.0),
+            ("serve_tick_timeout", 0.0), ("serve_max_restarts", 3),
+            ("serve_deadline_s", 0.0),
         ) if getattr(args, name) != default]
         if stray:
             raise ValueError(
@@ -313,6 +325,34 @@ def get_args(argv=None):
                              "0 (default) uses the model context length. "
                              "Smaller values cut the cache footprint "
                              "when serving short sequences.")
+    parser.add_argument("--drain_timeout", type=float, default=30.0,
+                        help="Graceful-drain budget on SIGTERM/SIGINT in "
+                             "--mode serve: admission closes immediately, "
+                             "in-flight (and queued) requests get this "
+                             "many seconds to finish, the remainder fail "
+                             "with reason 'preempted'. Completed JSONL "
+                             "results are already on disk either way.")
+    parser.add_argument("--serve_tick_timeout", type=float, default=0.0,
+                        help="Fault supervisor: if one decode tick makes "
+                             "no progress for this many seconds, dump a "
+                             "flight record (all thread stacks + device "
+                             "memory), fail the in-flight requests, and "
+                             "restart the decode loop with bounded "
+                             "exponential backoff (queued requests are "
+                             "kept; the compiled programs survive, so a "
+                             "restart costs zero recompiles). 0 disables.")
+    parser.add_argument("--serve_max_restarts", type=int, default=3,
+                        help="Supervisor restart budget: after this many "
+                             "decode-loop restarts the engine fails "
+                             "loudly instead of flapping.")
+    parser.add_argument("--serve_deadline_s", type=float, default=0.0,
+                        help="Default per-request deadline (seconds from "
+                             "submission) applied when a request carries "
+                             "no 'deadline_s' of its own: expired "
+                             "requests are shed from the queue (HTTP "
+                             "504) and admission rejects up front when "
+                             "the backlog already predicts a miss (HTTP "
+                             "429 + Retry-After). 0 = no default.")
 
     # Training configuration
     parser.add_argument("--n_epochs", type=int, default=2,

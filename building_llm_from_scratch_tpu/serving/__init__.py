@@ -22,20 +22,32 @@ installed ``bllm-tpu`` entry point) — see README "Serving".
 
 from building_llm_from_scratch_tpu.serving.engine import DecodeEngine
 from building_llm_from_scratch_tpu.serving.queue import (
+    EngineDrainingError,
     QueueFullError,
     RequestQueue,
+    SLOShedError,
 )
 from building_llm_from_scratch_tpu.serving.request import (
     Request,
+    RequestExpiredError,
     SamplingParams,
 )
 from building_llm_from_scratch_tpu.serving.scheduler import Scheduler
+from building_llm_from_scratch_tpu.serving.supervisor import (
+    EngineSupervisor,
+    FaultHooks,
+)
 
 __all__ = [
     "DecodeEngine",
+    "EngineDrainingError",
+    "EngineSupervisor",
+    "FaultHooks",
     "QueueFullError",
     "Request",
+    "RequestExpiredError",
     "RequestQueue",
+    "SLOShedError",
     "SamplingParams",
     "Scheduler",
 ]

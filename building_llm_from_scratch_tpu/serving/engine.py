@@ -20,6 +20,30 @@ queue-wait/TTFT/TPOT, slot-occupancy + queue-depth gauges, periodic
 from the ``CompileWatcher``-wrapped prefill/decode programs — after
 warmup, a prompt outside the warmed bucket set surfaces as a ``recompile``
 event with the leaf diff instead of a silent latency cliff.
+
+Resilience (this round — the serving counterpart of PR 1's training
+fault tolerance):
+
+  - DEADLINE-AWARE ADMISSION: requests carry ``deadline_s``; the queue
+    sheds expired requests at admission boundaries (``request_expired``)
+    and ``submit()`` rejects up front when queue position x the live
+    TPOT-EWMA service estimate already blows the deadline
+    (``request_shed`` / ``SLOShedError`` -> HTTP 429 + Retry-After).
+  - FAULT ISOLATION: a poison request (raising callback, prefill fault,
+    NaN-poisoned KV) fails ALONE with a ``request_failed{reason}`` event
+    and frees its slot; co-resident requests' tokens are bit-identical
+    to a fault-free run. An in-graph finite-logit guard retires a slot
+    streaming non-finite logits instead of feeding garbage to a client.
+  - SUPERVISED RESTART: a hung tick (``serving/supervisor.py`` watchdog
+    on ``obs/stall.py``) dumps a flight record, fails in-flight requests,
+    and restarts the decode loop with bounded backoff (``engine_restart``)
+    — the compiled programs and their CompileWatchers survive, so the
+    restarted engine serves with ZERO recompiles; queued requests are
+    kept.
+  - GRACEFUL DRAIN: ``drain()`` closes admission (``EngineDrainingError``
+    -> HTTP 503 + Retry-After), finishes in-flight + queued work within
+    a timeout, and fails the remainder with reason ``preempted``
+    (``drain`` events bracket it).
 """
 
 from __future__ import annotations
@@ -46,14 +70,20 @@ from building_llm_from_scratch_tpu.models.transformer import (
 from building_llm_from_scratch_tpu.obs.compile import CompileWatcher
 from building_llm_from_scratch_tpu.obs.metrics import get_metrics
 from building_llm_from_scratch_tpu.serving.queue import (
+    EngineDrainingError,
     QueueFullError,
     RequestQueue,
+    SLOShedError,
 )
 from building_llm_from_scratch_tpu.serving.request import (
+    FINISH_CANCELLED,
     FINISH_EOS,
     FINISH_ERROR,
+    FINISH_EXPIRED,
     FINISH_LENGTH,
+    FINISH_PREEMPTED,
     FINISHED,
+    QUEUED,
     REJECTED,
     RUNNING,
     Request,
@@ -62,6 +92,10 @@ from building_llm_from_scratch_tpu.serving.request import (
     resolve_eos,
 )
 from building_llm_from_scratch_tpu.serving.scheduler import Scheduler
+from building_llm_from_scratch_tpu.serving.supervisor import (
+    EngineSupervisor,
+    FaultHooks,
+)
 from building_llm_from_scratch_tpu.utils.logging import setup_logger
 
 logger = setup_logger(__name__)
@@ -88,7 +122,11 @@ class DecodeEngine:
                  max_queue: int = 64, max_top_k: int = 64,
                  default_max_new_tokens: int = 128,
                  warmup_prompt_cap: int = 256, metrics_every: int = 32,
-                 watch_compiles: bool = True):
+                 watch_compiles: bool = True,
+                 default_deadline_s: Optional[float] = None,
+                 tick_timeout_s: float = 0.0, max_restarts: int = 3,
+                 restart_backoff_s: float = 0.5,
+                 hooks: Optional[FaultHooks] = None):
         import jax
 
         self.cfg = cfg
@@ -101,6 +139,14 @@ class DecodeEngine:
         self.default_max_new_tokens = int(default_max_new_tokens)
         self.warmup_prompt_cap = min(int(warmup_prompt_cap), self.max_len)
         self.metrics_every = int(metrics_every)
+        self.default_deadline_s = default_deadline_s
+        self.hooks = hooks or FaultHooks()
+        self.max_restarts = int(max_restarts)
+        self.restart_backoff_s = float(restart_backoff_s)
+        self.supervisor = (EngineSupervisor(self, tick_timeout_s,
+                                            max_restarts=max_restarts,
+                                            backoff_s=restart_backoff_s)
+                           if tick_timeout_s > 0 else None)
 
         self.queue = RequestQueue(max_queue)
         self.scheduler = Scheduler(self.n_slots)
@@ -139,7 +185,19 @@ class DecodeEngine:
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._dead: Optional[str] = None        # set by _fail_all
+        self._draining = False                  # set by drain()
+        # bumped on every supervisor restart; a stale loop thread (one
+        # that eventually un-wedges after being abandoned) sees the bump
+        # and exits WITHOUT committing any state (see step())
+        self._generation = 0
+        self._restart_lock = threading.Lock()
+        self.n_restarts = 0
         self.warmed_up = False
+        # live service-time estimate for SLO-aware admission: EWMAs of
+        # per-token decode time and tokens-per-request over finished
+        # requests (alpha 0.2 — a few requests of history dominate)
+        self._tpot_ewma: Optional[float] = None
+        self._tokens_ewma: Optional[float] = None
 
         # rolling serve accounting (histogram material for request_done /
         # serve_summary events and the frontends' reports); bounded so a
@@ -149,6 +207,9 @@ class DecodeEngine:
         self.tokens_generated = 0
         self.requests_finished = 0
         self.requests_rejected = 0
+        self.requests_failed = 0
+        self.requests_shed = 0
+        self.requests_expired = 0
         self.ttft_hist = collections.deque(maxlen=self._HIST_MAX)
         self.tpot_hist = collections.deque(maxlen=self._HIST_MAX)
         self.queue_wait_hist = collections.deque(maxlen=self._HIST_MAX)
@@ -170,11 +231,16 @@ class DecodeEngine:
         tok = sample_tokens_dynamic(
             logits[None], key0[None], jnp.reshape(temp, (1,)),
             jnp.reshape(topk, (1,)), self.max_top_k)[0]
-        return tok, cache["k"], cache["v"]
+        # in-graph finite guard: non-finite logits mean the slot would
+        # stream garbage — the host retires the request with an error
+        # status instead (scalar flag; adds one all-reduce over V)
+        ok = jnp.all(jnp.isfinite(logits))
+        return tok, ok, cache["k"], cache["v"]
 
     def _decode_impl(self, cache_k, cache_v, tokens, lengths, base_keys,
                      n_gen, temps, topks):
         import jax
+        import jax.numpy as jnp
 
         logits, cache = decode_slots(
             self.params, self.cfg, tokens[:, None], lengths,
@@ -182,7 +248,11 @@ class DecodeEngine:
         keys = jax.vmap(token_rng)(base_keys, n_gen)
         nxt = sample_tokens_dynamic(logits, keys, temps, topks,
                                     self.max_top_k)
-        return nxt, cache["k"], cache["v"]
+        # per-row finite guard: slot independence means a numerically
+        # poisoned row (bad KV state) goes non-finite ALONE — the host
+        # retires just that slot (reason non_finite_logits)
+        ok = jnp.all(jnp.isfinite(logits), axis=-1)
+        return nxt, ok, cache["k"], cache["v"]
 
     # -- admission --------------------------------------------------------
 
@@ -217,6 +287,13 @@ class DecodeEngine:
         ids = np.asarray(ids, np.int32).reshape(-1)
         if ids.size < 1:
             raise ValueError("empty prompt")
+        if int(ids.min()) < 0 or int(ids.max()) >= self.cfg.vocab_size:
+            # out-of-vocab ids make the embedding gather fill NaN and the
+            # slot would stream garbage until the finite guard retires it
+            # — reject the poison at submit instead of burning a slot
+            raise ValueError(
+                f"prompt token ids must be in [0, {self.cfg.vocab_size}); "
+                f"got range [{int(ids.min())}, {int(ids.max())}]")
         return ids
 
     def submit(self, prompt, params: Optional[SamplingParams] = None,
@@ -224,10 +301,24 @@ class DecodeEngine:
                on_token=None) -> Request:
         """Enqueue one request (thread-safe). ``block=False`` rejects with
         ``QueueFullError`` when the bounded queue is at capacity;
-        ``block=True`` waits for space (backpressure)."""
+        ``block=True`` waits for space (backpressure). Raises
+        ``EngineDrainingError`` once ``drain()`` has closed admission and
+        ``SLOShedError`` when the request's deadline is predicted
+        unmeetable from the current backlog."""
         if self._dead is not None:
             raise RuntimeError(f"engine is dead: {self._dead}")
+        if self._draining:
+            raise EngineDrainingError(
+                "engine is draining: admission closed",
+                retry_after_s=self.estimate_queue_clear_s())
         params = params or SamplingParams()
+        if params.deadline_s is None and self.default_deadline_s:
+            import dataclasses
+
+            params = dataclasses.replace(
+                params, deadline_s=self.default_deadline_s)
+        if params.deadline_s is not None and params.deadline_s <= 0:
+            raise ValueError("deadline_s must be > 0")
         ids = self.encode_prompt(prompt)
         if params.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
@@ -242,6 +333,26 @@ class DecodeEngine:
                 f"prompt ({ids.size}) + max_new_tokens "
                 f"({params.max_new_tokens}) = {total} exceeds the "
                 f"engine's slot capacity {self.max_len}")
+        if params.deadline_s is not None:
+            # SLO-aware rejection: estimated completion = (queue position
+            # / n_slots) x EWMA per-request service time + the request's
+            # own decode budget x TPOT. Predictably blowing the deadline
+            # gets a useful 429 NOW instead of a useless 504 later.
+            est = self.estimate_completion_s(len(self.queue),
+                                             params.max_new_tokens)
+            if est is not None and est > params.deadline_s:
+                with self._lock:
+                    self.requests_shed += 1
+                retry = round(max(self.estimate_queue_clear_s() or 0.0,
+                                  0.001), 3)
+                get_metrics().event(
+                    "request_shed", queue_depth=len(self.queue),
+                    deadline_s=params.deadline_s,
+                    estimated_e2e_s=round(est, 4), retry_after_s=retry)
+                raise SLOShedError(
+                    f"deadline {params.deadline_s}s unmeetable: estimated "
+                    f"completion {est:.2f}s at queue depth "
+                    f"{len(self.queue)}", retry_after_s=retry)
         req = Request(next_request_id(), ids, params, on_token=on_token)
         try:
             self.queue.put(req, block=block, timeout=timeout)
@@ -253,20 +364,115 @@ class DecodeEngine:
                                 queue_depth=len(self.queue))
             req._mark_done()
             raise
-        if self._dead is not None:
-            # raced _fail_all: a blocked put() can be woken by the death
-            # drain and append into the dead engine — nothing will ever
-            # process it, so fail it here instead of hanging result()
-            req.error = self._dead
-            req.finish_reason = FINISH_ERROR
-            req.state = FINISHED
-            req._mark_done()
-            raise RuntimeError(f"engine is dead: {self._dead}")
+        if self._dead is not None or self._draining:
+            # raced _fail_all/drain: a blocked put() can be woken by the
+            # death/drain queue sweep and append into an engine that will
+            # never process it — fail it here instead of hanging result()
+            msg = self._dead or "engine is draining"
+            if self.queue.remove(req):
+                # still queued: we own it — retire it here
+                req.error = msg
+                req.finish_reason = (FINISH_ERROR if self._dead
+                                     else FINISH_PREEMPTED)
+                req.state = FINISHED
+                req._mark_done()
+            elif self._draining and self._dead is None:
+                # the decode loop popped it first: admission beat the
+                # drain, the request IS being served and drain will let
+                # it finish — force-finishing here would double-finish a
+                # live request. Hand the caller its (valid) handle.
+                with self._work:
+                    self._work.notify()
+                return req
+            # remove failed + dead: the _fail_all sweep already retired it
+            if self._dead is not None:
+                raise RuntimeError(f"engine is dead: {self._dead}")
+            raise EngineDrainingError("engine is draining: admission closed")
         with self._work:
             self._work.notify()
         return req
 
-    def _admit(self, slot: int, req: Request) -> None:
+    # -- SLO service estimate ---------------------------------------------
+
+    def estimate_completion_s(self, queue_depth: int,
+                              max_new_tokens: int) -> Optional[float]:
+        """Predicted submit->finish seconds for a request entering the
+        queue at ``queue_depth``: (queue position + the already-RUNNING
+        requests, counted half-done on average) x the EWMA per-request
+        service time (spread over ``n_slots`` concurrent rows) + its own
+        decode budget at the EWMA TPOT. Without the in-flight term a
+        full-slots/empty-queue engine would predict zero wait and admit
+        requests straight into a TTL expiry. None until at least one
+        request has finished (no history — admission stays optimistic)."""
+        if self._tpot_ewma is None or self._tokens_ewma is None:
+            return None
+        per_request = self._tokens_ewma * self._tpot_ewma
+        backlog = queue_depth + 0.5 * self.scheduler.n_active
+        wait = (backlog / max(self.n_slots, 1)) * per_request
+        return wait + max_new_tokens * self._tpot_ewma
+
+    def estimate_queue_clear_s(self) -> Optional[float]:
+        """Rough seconds until the current backlog drains (Retry-After
+        material for 429/503 responses)."""
+        if self._tpot_ewma is None or self._tokens_ewma is None:
+            return None
+        per_request = self._tokens_ewma * self._tpot_ewma
+        backlog = len(self.queue) + self.scheduler.n_active
+        return round((backlog / max(self.n_slots, 1)) * per_request, 3)
+
+    def _observe_service_time(self, req: Request) -> None:
+        """Fold one finished request into the TPOT/length EWMAs (only
+        normal completions: failed/expired requests have no useful
+        service signature)."""
+        tpot = req.tpot_s()
+        n_tok = len(req.output_ids)
+        if tpot is None or n_tok < 1:
+            return
+        alpha = 0.2
+        self._tpot_ewma = (tpot if self._tpot_ewma is None
+                           else (1 - alpha) * self._tpot_ewma
+                           + alpha * tpot)
+        self._tokens_ewma = (float(n_tok) if self._tokens_ewma is None
+                             else (1 - alpha) * self._tokens_ewma
+                             + alpha * n_tok)
+
+    # -- admission-boundary shed ------------------------------------------
+
+    def _admission_skip(self, req: Request) -> bool:
+        """Scheduler skip hook: shed expired/cancelled requests the moment
+        they reach the queue head, without consuming a slot."""
+        if req._cancelled:
+            self._fail_request(None, req, "cancelled while queued",
+                               reason="cancelled", finish=FINISH_CANCELLED)
+            return True
+        if req.expired():
+            self.requests_expired += 1
+            waited = time.monotonic() - req.t_submit
+            req.error = (f"deadline {req.params.deadline_s}s passed after "
+                         f"{waited:.2f}s in queue")
+            req.finish_reason = FINISH_EXPIRED
+            req.state = FINISHED
+            req.t_finish = time.monotonic()
+            get_metrics().event("request_expired", request_id=req.id,
+                                deadline_s=req.params.deadline_s,
+                                queue_wait_s=round(waited, 4),
+                                queue_depth=len(self.queue))
+            req._mark_done()
+            return True
+        return False
+
+    def _admit(self, slot: int, req: Request, gen: int) -> None:
+        """Prefill one admitted request into ``slot``. Fault-isolated: a
+        host-side fault on THIS request's path (injected prefill fault,
+        raising client callback, detok error) fails it alone and frees the
+        slot — co-resident requests never see it. (Device-side faults that
+        poison the whole batch escape to the loop and go through the
+        supervisor restart instead.)
+
+        ``gen`` is the caller's generation stamp: the prefill device call
+        is a wedge point the supervisor may abandon, so a thread that
+        un-wedges here must re-check before committing the new cache —
+        otherwise it would overwrite the restarted engine's fresh KV."""
         Tp = int(req.prompt_ids.size)
         Tpb = self._bucket_len(Tp)
         padded = np.zeros((1, Tpb), np.int32)
@@ -274,9 +480,19 @@ class DecodeEngine:
         base_key = np.asarray(_prng_key(req.params.seed))
         temp = np.float32(req.params.temperature)
         topk = np.int32(req.params.top_k or 0)
-        tok, k, v = self._prefill(self.cache["k"], self.cache["v"], padded,
-                                  np.int32(Tp), np.int32(slot), base_key,
-                                  temp, topk)
+        try:
+            self.hooks.before_prefill(req)
+        except Exception as e:  # noqa: BLE001 — poison request, isolate
+            if self._generation != gen:
+                return      # restart already failed this request
+            self._fail_request(slot, req, f"prefill failed: {e!r}",
+                               reason="prefill_error")
+            return
+        tok, ok, k, v = self._prefill(self.cache["k"], self.cache["v"],
+                                      padded, np.int32(Tp), np.int32(slot),
+                                      base_key, temp, topk)
+        if self._generation != gen:
+            return          # abandoned mid-prefill: commit nothing
         self.cache = {"k": k, "v": v}
         req.state = RUNNING
         req.slot = slot
@@ -286,40 +502,99 @@ class DecodeEngine:
         self._base_keys[slot] = base_key
         self._temps[slot] = temp
         self._topks[slot] = topk
-        self._accept_token(slot, req, int(tok))
+        if self.hooks.poison_nan(req):
+            self._poison_slot_cache(slot)      # fault injection (tests)
+        if not bool(ok):
+            self._fail_request(slot, req,
+                               "non-finite logits in prefill",
+                               reason="non_finite_logits")
+            return
+        self._accept_token(slot, req, int(tok), gen)
+
+    def _poison_slot_cache(self, slot: int) -> None:
+        """Overwrite one slot's KV rows with NaN (fault-injection hook):
+        the next decode tick's logits for that row go non-finite IN-GRAPH,
+        exercising the finite guard through the real compiled program —
+        same shapes, zero recompiles, co-resident rows untouched (their
+        attention never reads another slot's rows)."""
+        import jax.numpy as jnp
+
+        def nan_row(layer):
+            host = np.asarray(layer).copy()
+            host[slot] = np.nan
+            return jnp.asarray(host)
+
+        self.cache = {"k": [nan_row(K) for K in self.cache["k"]],
+                      "v": [nan_row(V) for V in self.cache["v"]]}
 
     # -- the tick ---------------------------------------------------------
 
     def step(self) -> bool:
         """One engine tick: admit into free slots, then one fused decode
         step over the slot batch. Returns False when fully idle (no active
-        slots and nothing queued)."""
-        with self._lock:
+        slots and nothing queued).
+
+        Generation-guarded: ``_restart`` bumps ``self._generation`` and
+        replaces the lock, so a tick that un-wedges AFTER the supervisor
+        abandoned it discovers the bump at the next checkpoint and returns
+        without committing any state into the restarted engine."""
+        gen = self._generation
+        lock = self._lock
+        with lock:
+            if self._generation != gen or self._dead is not None:
+                return False
+            self.hooks.before_tick(self)       # injected hang/fault point
+            if self._generation != gen:
+                return False
             # re-run admission until no progress: a request can finish
             # DURING admission (eos on its first sampled token, or
             # max_new_tokens=1), freeing its slot after admit_from already
             # returned — without the retry those queued behind it would
             # strand (step() would report idle with a non-empty queue)
             while True:
-                admitted = self.scheduler.admit_from(self.queue)
+                admitted = self.scheduler.admit_from(
+                    self.queue, skip=self._admission_skip)
                 for slot, req in admitted:
-                    self._admit(slot, req)
+                    self._admit(slot, req, gen)
+                    if self._generation != gen:
+                        return False
                 if not admitted:
                     break
+            # client cancellations retire at the tick boundary: the slot
+            # frees NOW instead of decoding to max_new_tokens for nobody
+            for slot, req in self.scheduler.active():
+                if req._cancelled:
+                    self._fail_request(slot, req, "cancelled by client",
+                                       reason="cancelled",
+                                       finish=FINISH_CANCELLED)
             active = self.scheduler.active()
             if not active:
                 # all slots free => admission drained the queue too
                 return False
-            nxt, k, v = self._decode(
+            nxt, ok, k, v = self._decode(
                 self.cache["k"], self.cache["v"], self._last_tokens,
                 self._lengths, self._base_keys, self._n_gen, self._temps,
                 self._topks)
+            if self._generation != gen:
+                return False
             self.cache = {"k": k, "v": v}
             nxt = np.asarray(nxt)
+            ok_rows = np.asarray(ok)
             for slot, req in active:
+                # a slow-client hook inside _accept_token is a wedge point
+                # the supervisor may abandon mid-loop — stop committing
+                # rows the moment the generation moves on
+                if self._generation != gen:
+                    return False
                 # this tick wrote the slot's previous token at _lengths
                 self._lengths[slot] += 1
-                self._accept_token(slot, req, int(nxt[slot]))
+                if not bool(ok_rows[slot]):
+                    self._fail_request(
+                        slot, req,
+                        f"non-finite logits at token {len(req.output_ids)}",
+                        reason="non_finite_logits")
+                    continue
+                self._accept_token(slot, req, int(nxt[slot]), gen)
             self.n_ticks += 1
             self._maybe_log_metrics()
             return True
@@ -328,7 +603,8 @@ class DecodeEngine:
         while self.step():
             pass
 
-    def _accept_token(self, slot: int, req: Request, tok: int) -> None:
+    def _accept_token(self, slot: int, req: Request, tok: int,
+                      gen: int) -> None:
         eos = resolve_eos(req.params, self.cfg.eos_id)
         if eos is not None and tok == eos:
             # the triggering eos is dropped (generate()'s per-row
@@ -343,9 +619,26 @@ class DecodeEngine:
         self._n_gen[slot] = len(req.output_ids)
         self.tokens_generated += 1
         self._window_tokens += 1
-        piece = self._detok_piece(req)
-        if req.on_token is not None:
-            req.on_token(req, tok, piece)
+        try:
+            # the request's OWN host path: detok + client callback. A
+            # fault here (raising on_token, tokenizer bug on this output)
+            # is this request's problem alone — fail it, free the slot,
+            # co-residents decode on undisturbed
+            piece = self._detok_piece(req)
+            if req.on_token is not None:
+                req.on_token(req, tok, piece)
+            self.hooks.after_token(req, tok)   # injected slow-client point
+        except Exception as e:  # noqa: BLE001 — poison request, isolate
+            if self._generation != gen:
+                return      # restart already failed this request
+            self._fail_request(slot, req, f"token callback failed: {e!r}",
+                               reason="callback_error")
+            return
+        if self._generation != gen:
+            # the callback/hook above is a wedge point — un-wedging after
+            # a supervisor restart must not finish/free slots that now
+            # belong to the restarted engine
+            return
         if piece:
             req._push_piece(piece)
         if len(req.output_ids) >= req.params.max_new_tokens:
@@ -381,6 +674,35 @@ class DecodeEngine:
         req._detok_start = len(req.output_ids)
         return tail
 
+    def _free_slot(self, slot: int) -> None:
+        self.scheduler.retire(slot)
+        self._lengths[slot] = 0
+        self._last_tokens[slot] = 0
+        self._n_gen[slot] = 0
+        self._temps[slot] = 0.0
+        self._topks[slot] = 0
+
+    def _fail_request(self, slot: Optional[int], req: Request, msg: str,
+                      reason: str, finish: str = FINISH_ERROR) -> None:
+        """Fail ONE request (fault isolation): free its slot if it holds
+        one, surface the error on the handle, emit ``request_failed`` with
+        the machine-readable ``reason`` — the engine itself keeps serving.
+        """
+        if slot is not None and self.scheduler.slots[slot] is req:
+            self._free_slot(slot)
+        req.error = msg
+        req.finish_reason = finish
+        req.state = FINISHED
+        req.t_finish = time.monotonic()
+        self.requests_failed += 1
+        get_metrics().event("request_failed", request_id=req.id,
+                            reason=reason, error=msg, slot=slot,
+                            n_tokens=len(req.output_ids))
+        logger.warning("Request %d failed (%s): %s", req.id, reason, msg)
+        req._mark_done()
+        with self._work:
+            self._work.notify_all()
+
     def _finish(self, slot: int, req: Request, reason: str) -> None:
         tail = self._detok_piece(req, final=True)  # flush any held bytes
         if tail:
@@ -388,13 +710,10 @@ class DecodeEngine:
         req.state = FINISHED
         req.finish_reason = reason
         req.t_finish = time.monotonic()
-        self.scheduler.retire(slot)
-        self._lengths[slot] = 0
-        self._last_tokens[slot] = 0
-        self._n_gen[slot] = 0
-        self._temps[slot] = 0.0
-        self._topks[slot] = 0
+        if self.scheduler.slots[slot] is req:  # not reassigned by restart
+            self._free_slot(slot)
         self.requests_finished += 1
+        self._observe_service_time(req)
         for hist, val in ((self.ttft_hist, req.ttft_s()),
                           (self.tpot_hist, req.tpot_s()),
                           (self.queue_wait_hist, req.queue_wait_s()),
@@ -437,11 +756,11 @@ class DecodeEngine:
         zero_key = np.zeros_like(self._base_keys[0])
         for Tpb in buckets:
             dummy = np.zeros((1, Tpb), np.int32)
-            tok, k, v = self._prefill(
+            tok, _ok, k, v = self._prefill(
                 self.cache["k"], self.cache["v"], dummy, np.int32(1),
                 np.int32(0), zero_key, np.float32(0.0), np.int32(0))
             self.cache = {"k": k, "v": v}
-        nxt, k, v = self._decode(
+        nxt, _ok, k, v = self._decode(
             self.cache["k"], self.cache["v"], self._last_tokens,
             self._lengths, self._base_keys, self._n_gen, self._temps,
             self._topks)
@@ -474,16 +793,37 @@ class DecodeEngine:
         if self._thread is not None:
             return
         self._stop.clear()
+        if self.supervisor is not None:
+            self.supervisor.start()
+        self._spawn_loop()
+
+    def _spawn_loop(self) -> None:
+        """Start one decode-loop thread bound to the CURRENT generation.
+        A stale thread (superseded by ``_restart``) exits at its next
+        checkpoint without touching engine state."""
+        gen = self._generation
 
         def loop():
-            while not self._stop.is_set():
+            while not self._stop.is_set() and self._generation == gen:
+                if self.supervisor is not None:
+                    self.supervisor.notify_tick()
+                if self._heartbeat is not None:
+                    self._heartbeat()
                 try:
                     progressed = self.step()
                 except Exception as e:          # noqa: BLE001 — must not
                     # die silently: callers block on result() forever and
                     # shutdown(drain=True) spins if requests just vanish
+                    if self._generation != gen:
+                        return                  # superseded: not ours
                     logger.exception("decode-engine loop died")
-                    self._fail_all(f"engine loop error: {e!r}")
+                    # batch-wide fault: with a supervisor and restart
+                    # budget left, fail only the in-flight batch and come
+                    # back up; otherwise the engine dies loudly
+                    if self.supervisor is None or not self._restart(
+                            reason="loop_error",
+                            detail=f"engine loop error: {e!r}"):
+                        self._fail_all(f"engine loop error: {e!r}")
                     return
                 if not progressed:
                     with self._work:
@@ -493,11 +833,87 @@ class DecodeEngine:
                                         daemon=True)
         self._thread.start()
 
+    #: external per-tick heartbeat (``--stall_timeout`` flight recorder in
+    #: serve mode rides this without the full supervisor)
+    _heartbeat = None
+
+    def set_heartbeat(self, fn) -> None:
+        self._heartbeat = fn
+
+    def _restart(self, reason: str, detail: str = "") -> bool:
+        """Supervisor recovery: abandon the (possibly wedged) loop thread,
+        fail the in-flight requests, keep the queue, rebuild the KV cache
+        and sync primitives, and bring up a fresh loop thread after a
+        bounded exponential backoff. The compiled prefill/decode programs
+        (and their CompileWatchers) are untouched — the restarted engine
+        reuses them, so recovery costs ZERO recompiles. Returns False when
+        the restart budget is exhausted (caller escalates to _fail_all).
+        """
+        with self._restart_lock:
+            if self._dead is not None or self._stop.is_set():
+                return False
+            if self.n_restarts >= self.max_restarts:
+                return False
+            self.n_restarts += 1
+            n_restart = self.n_restarts
+            # bump FIRST: the wedged thread checks the generation at every
+            # commit point, and must see the bump before we touch state
+            self._generation += 1
+            # fresh primitives — the abandoned thread may hold the old
+            # lock forever; new threads must not queue behind it
+            self._lock = threading.RLock()
+            self._work = threading.Condition()
+            failed = 0
+            with self._lock:
+                for slot, req in self.scheduler.active():
+                    self._fail_request(
+                        slot, req,
+                        f"engine restarted ({reason}): {detail}",
+                        reason="engine_restart")
+                    failed += 1
+                self._lengths[:] = 0
+                self._last_tokens[:] = 0
+                self._n_gen[:] = 0
+                self._temps[:] = 0.0
+                self._topks[:] = 0
+                # the old cache may be donation-poisoned or numerically
+                # corrupt; a fresh one has identical shapes/dtypes, so the
+                # frozen compiled programs accept it without recompiling
+                self.cache = init_slot_cache(self.cfg, self.n_slots,
+                                             self.max_len)
+            backoff = self.restart_backoff_s * (2.0 ** (n_restart - 1))
+            get_metrics().event(
+                "engine_restart", reason=reason, detail=detail,
+                n_restart=n_restart, max_restarts=self.max_restarts,
+                backoff_s=round(backoff, 3), n_inflight_failed=failed,
+                queue_depth=len(self.queue))
+            logger.error(
+                "Engine restart %d/%d (%s): failed %d in-flight "
+                "request(s), kept %d queued; backoff %.2fs.",
+                n_restart, self.max_restarts, reason, failed,
+                len(self.queue), backoff)
+            time.sleep(backoff)
+            if self._thread is not None:
+                self._spawn_loop()
+        return True
+
     def _fail_all(self, msg: str) -> None:
         """Fail every in-flight and queued request (engine loop death):
         set ``req.error`` so ``result()`` raises instead of hanging.
-        Marks the engine dead — later ``submit()`` calls raise."""
-        with self._lock:
+        Marks the engine dead — later ``submit()`` calls raise.
+
+        Timed lock acquire for the same reason as ``drain()``: the
+        supervisor's escalation path runs this WHILE the tick is wedged
+        holding the lock — a plain acquire would deadlock the recovery."""
+        lock = self._lock
+        locked = lock.acquire(timeout=5.0)
+        try:
+            if not locked:
+                with self._restart_lock:
+                    self._generation += 1   # wedged loop may never commit
+                    self._lock = threading.RLock()   # see drain(): later
+                    self._work = threading.Condition()  # paths must not
+                    # queue behind the lock the wedged thread holds
             self._dead = msg
             failed = 0
             for slot, req in self.scheduler.active():
@@ -517,8 +933,113 @@ class DecodeEngine:
                 req._mark_done()
                 failed += 1
             get_metrics().event("serve_error", error=msg, n_failed=failed)
+        finally:
+            if locked:
+                lock.release()
         with self._work:
             self._work.notify_all()
+
+    # -- graceful drain ----------------------------------------------------
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def cancel(self, req: Request) -> bool:
+        """Client gave up on ``req`` (HTTP timeout, disconnect): stop
+        spending decode on it. Queued requests are failed immediately;
+        running ones are marked and retired at the next tick boundary
+        (their slot frees instead of decoding to ``max_new_tokens`` for
+        nobody). Returns False when the request is already done."""
+        if req.done:
+            return False
+        req._cancelled = True
+        if req.state == QUEUED and self.queue.remove(req):
+            self._fail_request(None, req, "cancelled while queued",
+                               reason="cancelled", finish=FINISH_CANCELLED)
+        with self._work:
+            self._work.notify()
+        return True
+
+    def drain(self, timeout: float = 30.0) -> dict:
+        """Graceful drain: close admission (``submit()`` raises
+        ``EngineDrainingError`` -> HTTP 503), let in-flight AND queued
+        work finish within ``timeout`` seconds, then fail whatever is
+        left with reason ``preempted``. Idempotent; safe from any thread
+        (the SIGTERM path calls it off the signal watcher). Returns a
+        small summary dict (also emitted as the ``drain`` event)."""
+        t0 = time.monotonic()
+        already = self._draining
+        self._draining = True
+        if not already:
+            get_metrics().event(
+                "drain", phase="start", timeout_s=timeout,
+                n_active=self.scheduler.n_active,
+                queue_depth=len(self.queue))
+            logger.warning(
+                "Draining: admission closed; finishing %d in-flight + %d "
+                "queued request(s) within %.1fs.",
+                self.scheduler.n_active, len(self.queue), timeout)
+        deadline = t0 + timeout
+        if self._thread is not None:
+            while (time.monotonic() < deadline
+                   and (self.scheduler.n_active or len(self.queue))
+                   and self._thread.is_alive()
+                   and self._dead is None):
+                time.sleep(0.01)
+        else:
+            # manual mode (no loop thread): we do the ticking ourselves
+            while time.monotonic() < deadline and self.step():
+                pass
+        preempted = 0
+        # a WEDGED tick holds self._lock for the whole hung device call —
+        # a plain `with self._lock:` here would deadlock the drain (and
+        # the SIGTERM exit path behind it) forever, exactly the hang this
+        # PR exists to bound. Timed acquire: on timeout, retire the
+        # wedged loop via a generation bump (it can never commit state
+        # again — every commit point is generation-checked) and sweep the
+        # requests without the lock so clients and serve_jsonl unblock.
+        lock = self._lock
+        lock_wait = min(5.0, max(0.1, timeout))
+        locked = lock.acquire(timeout=lock_wait)
+        try:
+            if not locked:
+                logger.error(
+                    "Drain: decode tick wedged (lock held > %.1fs); "
+                    "abandoning it and force-failing in-flight requests.",
+                    lock_wait)
+                with self._restart_lock:
+                    self._generation += 1
+                    # the wedged thread holds the OLD lock forever — give
+                    # every later path (shutdown's stats(), submit's
+                    # counters) a fresh one or they deadlock behind it
+                    self._lock = threading.RLock()
+                    self._work = threading.Condition()
+            for slot, req in self.scheduler.active():
+                self._fail_request(
+                    slot, req,
+                    f"preempted: drain timeout {timeout}s elapsed",
+                    reason="preempted", finish=FINISH_PREEMPTED)
+                preempted += 1
+            while True:
+                req = self.queue.get_nowait()
+                if req is None:
+                    break
+                self._fail_request(
+                    None, req,
+                    f"preempted: drain timeout {timeout}s elapsed",
+                    reason="preempted", finish=FINISH_PREEMPTED)
+                preempted += 1
+        finally:
+            if locked:
+                lock.release()
+        summary = {"phase": "end", "n_preempted": preempted,
+                   "seconds": round(time.monotonic() - t0, 3),
+                   "requests_finished": self.requests_finished}
+        get_metrics().event("drain", **summary)
+        logger.warning("Drain complete in %.2fs (%d preempted).",
+                       summary["seconds"], preempted)
+        return summary
 
     def shutdown(self, drain: bool = True) -> None:
         """Stop the engine loop; with ``drain`` (default) finish everything
@@ -536,6 +1057,8 @@ class DecodeEngine:
             self._thread = None
         elif drain:
             self.run_until_idle()
+        if self.supervisor is not None:
+            self.supervisor.stop()
         get_metrics().event("serve_summary", **self.stats())
 
     def stats(self) -> dict:
@@ -543,9 +1066,14 @@ class DecodeEngine:
             out = {
                 "requests_finished": self.requests_finished,
                 "requests_rejected": self.requests_rejected,
+                "requests_failed": self.requests_failed,
+                "requests_shed": self.requests_shed,
+                "requests_expired": self.requests_expired,
                 "tokens_generated": self.tokens_generated,
                 "n_ticks": self.n_ticks,
                 "n_recompiles": self.n_recompiles,
+                "n_restarts": self.n_restarts,
+                "draining": self._draining,
             }
             hists = [("ttft_s", list(self.ttft_hist)),
                      ("tpot_s", list(self.tpot_hist)),

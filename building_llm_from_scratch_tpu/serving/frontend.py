@@ -4,26 +4,45 @@ Two dependency-free ways to put load on the engine:
 
   - JSONL batch (``--serve_prompts requests.jsonl``): one request per
     line — ``{"prompt": "...", "max_new_tokens": 32, "temperature": 0.7,
-    "top_k": 40, "seed": 1}`` (or ``"prompt_ids": [..]``). Results stream
-    to ``--serve_out`` (default stdout) as JSONL, one line per request in
-    submission order. Submission uses blocking backpressure: a full queue
+    "top_k": 40, "seed": 1, "deadline_s": 30}`` (or ``"prompt_ids":
+    [..]``). Results stream to ``--serve_out`` (default stdout) as JSONL,
+    one line per request in submission order — each line is flushed the
+    moment its in-order handle completes, so a crash or drain never loses
+    finished work. Submission uses blocking backpressure: a full queue
     stalls the reader instead of rejecting.
   - HTTP (``--serve_port``): a stdlib ``http.server`` endpoint —
     ``POST /generate`` with the same JSON fields returns the generated
-    text + telemetry; a full queue returns 429 (reject-over-capacity);
-    ``GET /healthz`` reports slot/queue state.
+    text + telemetry; ``GET /healthz`` reports slot/queue/drain state.
+    Status mapping: 429 + Retry-After for queue-full AND SLO shed, 503 +
+    Retry-After while draining, 504 for queue-expired deadlines and
+    handler timeouts (the timed-out request is CANCELLED, freeing its
+    slot), 413 for oversized bodies, 400 for malformed JSON, 500 only
+    for engine-side faults.
+
+Run-mode resilience (``run_serve``): SIGTERM/SIGINT arm
+``training/resilience.GracefulStopper``; a watcher thread then drains the
+engine (admission closed, in-flight finishes within ``--drain_timeout``,
+the remainder fails with reason ``preempted``) and stops the HTTP server,
+so a preempted replica exits 0 with every completed result already
+written.
 """
 
 from __future__ import annotations
 
 import json
 import sys
+import threading
 from typing import List, Optional
 
 from building_llm_from_scratch_tpu.serving.engine import DecodeEngine
-from building_llm_from_scratch_tpu.serving.queue import QueueFullError
+from building_llm_from_scratch_tpu.serving.queue import (
+    EngineDrainingError,
+    QueueFullError,
+    SLOShedError,
+)
 from building_llm_from_scratch_tpu.serving.request import (
     Request,
+    RequestExpiredError,
     SamplingParams,
 )
 from building_llm_from_scratch_tpu.utils.logging import setup_logger
@@ -40,6 +59,11 @@ def params_from_record(rec: dict, default_max_new: int) -> SamplingParams:
         eos_id=(int(rec["eos_id"]) if "eos_id" in rec
                 and rec["eos_id"] is not None else None),
         ignore_eos=bool(rec.get("ignore_eos", False)),
+        # `is not None`, not truthiness: deadline_s=0 must flow through to
+        # engine.submit's `deadline_s must be > 0` ValueError (HTTP 400),
+        # not be silently promoted to "no deadline"
+        deadline_s=(float(rec["deadline_s"])
+                    if rec.get("deadline_s") is not None else None),
     )
 
 
@@ -50,11 +74,26 @@ def result_record(req: Request, text: Optional[str] = None) -> dict:
     return rec
 
 
+def error_record(req: Request) -> dict:
+    """The JSONL line for a request the engine failed/shed/preempted:
+    still one line in submission order, with the failure surfaced instead
+    of silently missing output."""
+    rec = req.summary()
+    rec["error"] = req.error
+    return rec
+
+
 def serve_jsonl(engine: DecodeEngine, prompts_path: str,
                 out_path: Optional[str], default_max_new: int) -> List[dict]:
     """Pump a JSONL request file through the engine (blocking
-    backpressure), write one result line per request in submission order."""
+    backpressure), write one result line per request in submission order.
+
+    Fault/drain-tolerant: a failed, expired or preempted request becomes
+    an ``error`` line instead of crashing the pump, and admission closing
+    mid-file (drain) records the unsubmitted remainder as shed — every
+    COMPLETED request's line is on disk either way."""
     handles: List[Request] = []
+    shed: List[dict] = []
     with open(prompts_path) as f:
         for lineno, line in enumerate(f, 1):
             line = line.strip()
@@ -66,9 +105,14 @@ def serve_jsonl(engine: DecodeEngine, prompts_path: str,
                 raise ValueError(
                     f"{prompts_path}:{lineno}: needs 'prompt' or "
                     "'prompt_ids'")
-            handles.append(engine.submit(
-                prompt, params_from_record(rec, default_max_new),
-                block=True))
+            try:
+                handles.append(engine.submit(
+                    prompt, params_from_record(rec, default_max_new),
+                    block=True))
+            except (EngineDrainingError, SLOShedError,
+                    QueueFullError) as e:
+                shed.append({"line": lineno, "error": str(e),
+                             "finish_reason": "shed"})
     # write each result as its in-order handle completes (flushed per
     # line) so finished work is durable even if a later request crashes
     # the process
@@ -76,15 +120,25 @@ def serve_jsonl(engine: DecodeEngine, prompts_path: str,
     out = open(out_path, "w") if out_path else sys.stdout
     try:
         for h in handles:
-            rec = result_record(h.result())
+            try:
+                rec = result_record(h.result())
+            except (RuntimeError, RequestExpiredError):
+                rec = error_record(h)
+            results.append(rec)
+            out.write(json.dumps(rec) + "\n")
+            out.flush()
+        for rec in shed:
             results.append(rec)
             out.write(json.dumps(rec) + "\n")
             out.flush()
     finally:
         if out_path:
             out.close()
-    logger.info("Served %d JSONL requests (%d tokens).", len(results),
-                sum(r["n_tokens"] for r in results))
+    n_ok = sum(1 for r in results if "error" not in r)
+    logger.info("Served %d/%d JSONL requests (%d tokens; %d failed/shed).",
+                n_ok, len(results),
+                sum(r.get("n_tokens", 0) for r in results),
+                len(results) - n_ok)
     return results
 
 
@@ -94,34 +148,60 @@ def serve_jsonl(engine: DecodeEngine, prompts_path: str,
 
 def make_http_server(engine: DecodeEngine, port: int,
                      host: str = "127.0.0.1",
-                     request_timeout_s: float = 300.0):
+                     request_timeout_s: float = 300.0,
+                     max_body_bytes: int = 1 << 20):
     """Build (not start) a ThreadingHTTPServer bound to ``port`` (0 = any
     free port; read the actual one off ``server.server_address``).
     Loopback-only by default — the endpoint is unauthenticated, so
-    exposing it (``host="0.0.0.0"`` / ``--serve_host``) is opt-in."""
+    exposing it (``host="0.0.0.0"`` / ``--serve_host``) is opt-in.
+
+    Input hardening: bodies over ``max_body_bytes`` get 413 without being
+    read, malformed/mistyped JSON gets 400 (never a handler traceback),
+    and a handler timeout CANCELS the underlying request so its slot
+    stops decoding for a client that already hung up."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     class Handler(BaseHTTPRequestHandler):
+        # socket read timeout (BaseRequestHandler.setup applies it): a
+        # client that sends Content-Length: N but stalls mid-body would
+        # otherwise block rfile.read(n) — and its handler thread — forever
+        # (slow-loris); on timeout http.server drops the connection
+        timeout = 60
+
         def log_message(self, fmt, *args):          # route through our logger
             logger.debug("http: " + fmt, *args)
 
-        def _json(self, code: int, payload: dict) -> None:
+        def _json(self, code: int, payload: dict,
+                  retry_after: Optional[float] = None) -> None:
             body = json.dumps(payload).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            if retry_after is not None:
+                # RFC 7231 delay-seconds (integer, >= 1)
+                self.send_header("Retry-After",
+                                 str(max(1, int(round(retry_after)))))
             self.end_headers()
             self.wfile.write(body)
 
         def do_GET(self):
             if self.path != "/healthz":
                 return self._json(404, {"error": "unknown path"})
+            if engine._dead is not None:
+                status = "dead"
+            elif engine.draining:
+                status = "draining"
+            else:
+                status = "serving"
             self._json(200, {
+                "status": status,
                 "slots": engine.n_slots,
                 "active": engine.scheduler.n_active,
                 "queue_depth": len(engine.queue),
                 "queue_capacity": engine.queue.max_size,
                 "warmed_up": engine.warmed_up,
+                "draining": engine.draining,
+                "restarts": engine.n_restarts,
             })
 
         def do_POST(self):
@@ -129,7 +209,21 @@ def make_http_server(engine: DecodeEngine, port: int,
                 return self._json(404, {"error": "unknown path"})
             try:
                 n = int(self.headers.get("Content-Length", 0))
+            except (TypeError, ValueError):
+                return self._json(400, {"error": "bad Content-Length"})
+            if n < 0:
+                return self._json(400, {"error": "bad Content-Length"})
+            if n > max_body_bytes:
+                # refuse WITHOUT reading: an oversized body must cost the
+                # server a header parse, not max_body_bytes of RAM
+                return self._json(413, {
+                    "error": f"body {n} bytes exceeds the "
+                             f"{max_body_bytes}-byte limit"})
+            try:
                 rec = json.loads(self.rfile.read(n) or b"{}")
+                if not isinstance(rec, dict):
+                    return self._json(
+                        400, {"error": "body must be a JSON object"})
                 prompt = rec.get("prompt_ids", rec.get("prompt"))
                 if prompt is None:
                     return self._json(
@@ -142,17 +236,32 @@ def make_http_server(engine: DecodeEngine, port: int,
                 return self._json(400, {"error": str(e)})
             try:
                 handle = engine.submit(prompt, params, block=False)
+            except EngineDrainingError as e:     # drain: try a peer
+                return self._json(503, {"error": str(e)},
+                                  retry_after=e.retry_after_s or 1.0)
+            except SLOShedError as e:            # deadline unmeetable now
+                return self._json(429, {
+                    "error": str(e), "shed": True},
+                    retry_after=e.retry_after_s or 1.0)
             except QueueFullError:
                 return self._json(429, {
                     "error": "request queue full — retry later",
-                    "queue_capacity": engine.queue.max_size})
+                    "queue_capacity": engine.queue.max_size},
+                    retry_after=engine.estimate_queue_clear_s() or 1.0)
             except ValueError as e:
                 return self._json(400, {"error": str(e)})
             except RuntimeError as e:           # engine is dead
                 return self._json(500, {"error": str(e)})
             try:
                 handle.result(timeout=request_timeout_s)
+            except RequestExpiredError as e:    # deadline shed in queue
+                return self._json(504, {"error": str(e), "expired": True},
+                                  retry_after=engine.estimate_queue_clear_s())
             except TimeoutError as e:
+                # cancel so the slot stops decoding for a client whose
+                # handler already gave up (it would otherwise burn the
+                # slot to max_new_tokens)
+                engine.cancel(handle)
                 return self._json(504, {"error": str(e)})
             except RuntimeError as e:           # engine failed the request
                 return self._json(500, {"error": str(e)})
@@ -162,8 +271,9 @@ def make_http_server(engine: DecodeEngine, port: int,
 
 
 def serve_http(engine: DecodeEngine, port: int,
-               host: str = "127.0.0.1") -> None:
-    server = make_http_server(engine, port, host=host)
+               host: str = "127.0.0.1",
+               server=None) -> None:
+    server = server or make_http_server(engine, port, host=host)
     host, real_port = server.server_address[:2]
     logger.info("Serving on http://%s:%d (POST /generate, GET /healthz); "
                 "Ctrl-C to stop.", host, real_port)
@@ -184,7 +294,16 @@ def run_serve(args, comps, metric_logger) -> DecodeEngine:
     ``comps``/``metric_logger`` come from main.py's shared bootstrap
     (metrics sink + compile cache + build_components + run-metadata
     header) so serve telemetry can't diverge from training telemetry.
-    Returns the (shut-down) engine for callers/tests."""
+    Returns the (shut-down) engine for callers/tests.
+
+    Resilience wiring: SIGTERM/SIGINT trigger a graceful drain
+    (``--drain_timeout``); ``--serve_tick_timeout`` arms the fault
+    supervisor (hung-tick flight record + bounded-backoff restart);
+    ``--stall_timeout`` alone arms just the flight recorder."""
+    from building_llm_from_scratch_tpu.training.resilience import (
+        GracefulStopper,
+    )
+
     engine = DecodeEngine(
         comps.cfg, comps.params, comps.tokenizer,
         n_slots=args.serve_slots,
@@ -192,16 +311,61 @@ def run_serve(args, comps, metric_logger) -> DecodeEngine:
         max_queue=args.serve_max_queue,
         max_top_k=args.serve_max_top_k,
         default_max_new_tokens=args.serve_max_new_tokens,
+        default_deadline_s=(args.serve_deadline_s or None),
+        tick_timeout_s=args.serve_tick_timeout,
+        max_restarts=args.serve_max_restarts,
     )
+    stall = None
+    if args.stall_timeout > 0 and engine.supervisor is None:
+        # flight recorder without the supervisor: a hung tick still dumps
+        # every thread's stack + device memory (obs/stall.py), it just
+        # isn't auto-restarted
+        from building_llm_from_scratch_tpu.serving.supervisor import (
+            make_serve_stall_detector,
+        )
+
+        stall = make_serve_stall_detector(args.stall_timeout)
+        engine.set_heartbeat(stall.notify_step)
     engine.warmup()
     engine.start()
+    if stall is not None:
+        stall.start()
+
+    server = (make_http_server(engine, args.serve_port,
+                               host=args.serve_host)
+              if args.serve_port else None)
+    stopper = GracefulStopper()
+    drained = threading.Event()
+
+    def _drain_on_signal():
+        # poll the stopper flag (the handler itself must stay tiny and
+        # async-signal-safe); on preemption: close admission, finish
+        # in-flight within --drain_timeout, then unblock the frontends
+        while not drained.wait(0.1):
+            if stopper.requested:
+                engine.drain(timeout=args.drain_timeout)
+                if server is not None:
+                    server.shutdown()
+                return
+
+    watcher = threading.Thread(target=_drain_on_signal,
+                               name="serve-drain-watch", daemon=True)
     try:
-        if args.serve_prompts:
-            serve_jsonl(engine, args.serve_prompts, args.serve_out,
-                        args.serve_max_new_tokens)
-        if args.serve_port:
-            serve_http(engine, args.serve_port, host=args.serve_host)
+        with stopper:
+            watcher.start()
+            if args.serve_prompts:
+                serve_jsonl(engine, args.serve_prompts, args.serve_out,
+                            args.serve_max_new_tokens)
+            if server is not None:
+                serve_http(engine, args.serve_port, host=args.serve_host,
+                           server=server)
     finally:
+        drained.set()
+        watcher.join(timeout=5)
+        if stopper.requested and not engine.draining:
+            engine.drain(timeout=args.drain_timeout)
         engine.shutdown()
+        if stall is not None:
+            stall.stop()
         metric_logger.close()
     return engine

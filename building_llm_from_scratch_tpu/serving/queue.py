@@ -22,6 +22,28 @@ class QueueFullError(Exception):
     """The bounded request queue is at capacity (reject-over-capacity)."""
 
 
+class SLOShedError(Exception):
+    """Admission predicted the request would blow its deadline before a
+    slot could serve it (queue position x the live TPOT-EWMA service
+    estimate), so it was shed at submit time — a useful 429 now instead
+    of a useless 504 later. ``retry_after_s`` is the estimate of when the
+    backlog will have drained enough to try again."""
+
+    def __init__(self, msg: str, retry_after_s: Optional[float] = None):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
+
+class EngineDrainingError(Exception):
+    """The engine is draining (SIGTERM/shutdown in progress): admission is
+    closed, in-flight work is finishing. The HTTP frontend maps this to
+    503 + Retry-After."""
+
+    def __init__(self, msg: str, retry_after_s: Optional[float] = None):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
+
 class RequestQueue:
     def __init__(self, max_size: int = 64):
         if max_size < 1:
@@ -60,6 +82,17 @@ class RequestQueue:
             req = self._q.popleft()
             self._not_full.notify()
             return req
+
+    def remove(self, req: Request) -> bool:
+        """Drop one specific queued request (client cancellation). Returns
+        False when it is not in the queue (already admitted or popped)."""
+        with self._not_full:
+            try:
+                self._q.remove(req)
+            except ValueError:
+                return False
+            self._not_full.notify()
+            return True
 
     def peek(self) -> Optional[Request]:
         with self._lock:

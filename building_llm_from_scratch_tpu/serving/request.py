@@ -25,6 +25,16 @@ REJECTED = "rejected"
 FINISH_EOS = "eos"       # sampled the request's eos (token dropped)
 FINISH_LENGTH = "length"  # hit max_new_tokens
 FINISH_ERROR = "error"   # engine failure (req.error holds the message)
+FINISH_EXPIRED = "expired"      # deadline passed while queued (shed)
+FINISH_PREEMPTED = "preempted"  # drain timeout hit before it finished
+FINISH_CANCELLED = "cancelled"  # client gave up (timeout/disconnect)
+
+
+class RequestExpiredError(RuntimeError):
+    """The request's deadline passed before it reached a slot — the engine
+    shed it at an admission boundary instead of burning decode time on a
+    result nobody is waiting for (``result()`` raises this; the HTTP
+    frontend maps it to 504)."""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -39,6 +49,12 @@ class SamplingParams:
 
     ``eos_id=None`` means the engine's model default; ``ignore_eos=True``
     disables eos stopping entirely (decode runs to the token budget).
+
+    ``deadline_s`` is the client's patience in seconds from submission:
+    past it the request is useless to whoever sent it, so the engine sheds
+    it from the queue instead of decoding into the void (and rejects at
+    submit time when the queue is already predicted to blow the deadline).
+    ``None`` = no deadline (the engine may apply its default).
     """
 
     max_new_tokens: int = 128
@@ -47,6 +63,7 @@ class SamplingParams:
     seed: int = 0
     eos_id: Optional[int] = None
     ignore_eos: bool = False
+    deadline_s: Optional[float] = None
 
 
 class Request:
@@ -66,9 +83,13 @@ class Request:
         self._detok_start = 0    # first output_ids index not yet in text
         self.slot: Optional[int] = None
         self.error: Optional[str] = None
+        self._cancelled = False  # client gave up; retired at next boundary
         # timestamps (time.monotonic): submit -> admit (queue wait) ->
         # first token (TTFT) -> finish (TPOT over the decode tail)
         self.t_submit = time.monotonic()
+        self.t_deadline: Optional[float] = (
+            self.t_submit + params.deadline_s
+            if params.deadline_s is not None else None)
         self.t_admit: Optional[float] = None
         self.t_first_token: Optional[float] = None
         self.t_finish: Optional[float] = None
@@ -79,14 +100,26 @@ class Request:
 
     def result(self, timeout: Optional[float] = None) -> "Request":
         """Block until the request finishes; returns self. Raises
-        ``RuntimeError`` if the engine failed the request (loop death)."""
+        ``RequestExpiredError`` when the deadline shed it in the queue,
+        ``RuntimeError`` for any other engine-side failure (fault
+        isolation, restart, preemption, cancellation)."""
         if not self._done.wait(timeout):
             raise TimeoutError(f"request {self.id} not finished "
                                f"within {timeout}s")
+        if self.finish_reason == FINISH_EXPIRED:
+            raise RequestExpiredError(
+                f"request {self.id} expired: {self.error}")
         if self.error is not None:
             raise RuntimeError(
                 f"request {self.id} failed: {self.error}")
         return self
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        """True when the request's deadline has passed (False without
+        one). The engine checks this at admission boundaries."""
+        if self.t_deadline is None:
+            return False
+        return (time.monotonic() if now is None else now) > self.t_deadline
 
     def stream(self, timeout: Optional[float] = None) -> Iterator[str]:
         """Yield detokenized text pieces as they are generated (ends when
@@ -141,6 +174,8 @@ class Request:
             "finish_reason": self.finish_reason,
             "slot": self.slot,
         }
+        if self.params.deadline_s is not None:
+            out["deadline_s"] = self.params.deadline_s
         for name, fn in (("queue_wait_s", self.queue_wait_s),
                          ("ttft_s", self.ttft_s), ("tpot_s", self.tpot_s),
                          ("e2e_s", self.e2e_s)):
@@ -180,5 +215,7 @@ def next_request_id() -> int:
 __all__: List[Any] = [
     "QUEUED", "RUNNING", "FINISHED", "REJECTED",
     "FINISH_EOS", "FINISH_LENGTH", "FINISH_ERROR",
+    "FINISH_EXPIRED", "FINISH_PREEMPTED", "FINISH_CANCELLED",
+    "RequestExpiredError",
     "SamplingParams", "Request", "resolve_eos", "next_request_id",
 ]

@@ -10,7 +10,7 @@ under load.
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+from typing import Callable, List, Optional, Tuple
 
 from building_llm_from_scratch_tpu.serving.queue import RequestQueue
 from building_llm_from_scratch_tpu.serving.request import Request
@@ -36,14 +36,23 @@ class Scheduler:
     def occupancy(self) -> float:
         return self.n_active / self.n_slots
 
-    def admit_from(self, queue: RequestQueue) -> List[Tuple[int, Request]]:
+    def admit_from(self, queue: RequestQueue,
+                   skip: Optional[Callable[[Request], bool]] = None
+                   ) -> List[Tuple[int, Request]]:
         """FCFS: fill free slots from the queue head; returns the
-        (slot, request) pairs admitted this boundary."""
+        (slot, request) pairs admitted this boundary.
+
+        ``skip`` is the admission-boundary shed hook: a popped request for
+        which it returns True is dropped WITHOUT consuming a slot (the
+        engine uses it for deadline expiry and client cancellation — the
+        callee is responsible for failing/finishing the request)."""
         admitted: List[Tuple[int, Request]] = []
         while self._free:
             req = queue.get_nowait()
             if req is None:
                 break
+            if skip is not None and skip(req):
+                continue
             slot = self._free.pop(0)
             self.slots[slot] = req
             admitted.append((slot, req))

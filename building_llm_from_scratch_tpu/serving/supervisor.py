@@ -1,0 +1,142 @@
+"""Serving fault isolation: injectable fault hooks + the tick-watchdog
+supervisor that restarts a wedged decode loop.
+
+The failure classes this targets mirror the training tier's
+(`training/resilience.py`, `tests/test_fault_injection.py`), re-cast for
+a server that must stay up:
+
+  - a POISON REQUEST (bad prompt, raising client callback, prefill that
+    trips a bug) must fail alone — its co-residents' token streams stay
+    bit-identical to a fault-free run (the engine's per-request isolation;
+    test-asserted);
+  - NON-FINITE LOGITS (numerically-poisoned KV state, flaky HBM) must
+    retire the offending slot with an error status instead of streaming
+    garbage tokens to a client (the engine's in-graph finite guard);
+  - a HUNG TICK (device wedged in a collective, runtime deadlock) must
+    produce a flight record — every thread's stack + device memory, via
+    ``obs/stall.StallDetector`` — and then a bounded-backoff engine
+    RESTART that fails only the in-flight requests, keeps the queue, and
+    serves new traffic with ZERO recompiles (the compiled programs and
+    their CompileWatchers survive the restart; only the KV cache and the
+    loop thread are replaced).
+
+``FaultHooks`` is the injection surface the serving fault tests drive —
+every hook is a no-op in production. Hooks run INSIDE the engine lock at
+well-defined points of the tick, so an injected hang is indistinguishable
+from a real one to the watchdog.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from building_llm_from_scratch_tpu.obs.stall import StallDetector
+from building_llm_from_scratch_tpu.utils.logging import setup_logger
+
+logger = setup_logger(__name__)
+
+
+class FaultHooks:
+    """Injectable fault points for the serving engine (all no-op by
+    default; tests replace individual attributes with closures).
+
+    - ``before_tick(engine)``: start of every tick, inside the engine
+      lock. Block here to simulate a hung tick; raise to simulate a
+      batch-wide loop fault.
+    - ``before_prefill(request)``: just before a request's prefill
+      program runs. Raise to make THIS request a poison request — the
+      engine fails it alone.
+    - ``poison_nan(request) -> bool``: return True to overwrite the
+      request's freshly-prefilled KV rows with NaN, so the next decode
+      tick produces non-finite logits for that slot IN-GRAPH (exercises
+      the finite-logit guard without a second compiled program).
+    - ``after_token(request, token)``: after each accepted token, inside
+      the lock. Sleep here to simulate a slow consumer stretching ticks.
+    """
+
+    def before_tick(self, engine) -> None:
+        pass
+
+    def before_prefill(self, request) -> None:
+        pass
+
+    def poison_nan(self, request) -> bool:
+        return False
+
+    def after_token(self, request, token) -> None:
+        pass
+
+
+class EngineSupervisor:
+    """Tick watchdog + restart policy for one ``DecodeEngine``.
+
+    A per-tick heartbeat feeds an ``obs/stall.StallDetector`` configured
+    to fire exactly at ``tick_timeout_s`` (``median_floor`` pinned to the
+    timeout disables the adaptive early trigger: serving ticks are
+    uniform, and the engine heartbeats through idle waits too, so the
+    fixed timeout is the right contract). On fire, the detector has
+    already dumped every thread's stack + device memory (the flight
+    record); the supervisor then asks the engine to restart its decode
+    loop. Restarts are bounded: ``max_restarts`` total, with exponential
+    backoff starting at ``backoff_s`` — a persistently-wedged device
+    fails the engine loudly instead of flapping forever.
+    """
+
+    def __init__(self, engine, tick_timeout_s: float,
+                 max_restarts: int = 3, backoff_s: float = 0.5):
+        if tick_timeout_s <= 0:
+            raise ValueError(
+                f"tick_timeout_s must be > 0, got {tick_timeout_s}")
+        self.engine = engine
+        self.tick_timeout_s = float(tick_timeout_s)
+        self.max_restarts = int(max_restarts)
+        self.backoff_s = float(backoff_s)
+        self._lock = threading.Lock()
+        self.detector = StallDetector(
+            timeout=self.tick_timeout_s,
+            median_floor=self.tick_timeout_s,
+            first_grace=1.0,
+            poll_interval=min(0.25, self.tick_timeout_s / 4),
+            on_stall=self._on_stall)
+
+    # -- heartbeat (engine loop thread) ----------------------------------
+
+    def notify_tick(self) -> None:
+        self.detector.notify_step()
+
+    # -- watchdog fire (detector thread) ---------------------------------
+
+    def _on_stall(self, elapsed: float, threshold: float) -> None:
+        # the detector already dumped the flight record (stacks + device
+        # memory + a `stall` event); what remains is the recovery action
+        with self._lock:
+            logger.error(
+                "Serving tick hung for %.1fs (threshold %.1fs): "
+                "restarting the decode loop.", elapsed, threshold)
+            if not self.engine._restart(
+                    reason="hung_tick",
+                    detail=f"tick made no progress for {elapsed:.1f}s"):
+                self.engine._fail_all(
+                    f"hung tick and restart budget exhausted "
+                    f"({self.max_restarts} restarts)")
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> "EngineSupervisor":
+        self.detector.start()
+        return self
+
+    def stop(self) -> None:
+        self.detector.stop()
+
+
+def make_serve_stall_detector(timeout_s: float,
+                              on_stall: Optional[Callable] = None
+                              ) -> StallDetector:
+    """A plain flight-recorder StallDetector for ``--mode serve`` without
+    the supervisor (``--stall_timeout``): dumps stacks on a hung tick,
+    restarts nothing. Heartbeats come from the engine loop."""
+    return StallDetector(timeout=float(timeout_s),
+                         median_floor=float(timeout_s),
+                         first_grace=2.0, on_stall=on_stall)

@@ -107,6 +107,62 @@ print(f"serving smoke ok: {len(results)} requests, "
       f"{len(done)} request_done events, 0 recompiles")
 EOF
 
+echo "== serving drain smoke (SIGTERM mid-serve, CPU) =="
+JAX_PLATFORMS=cpu python - <<'EOF' || exit 1
+import json, os, signal, subprocess, sys, tempfile, time
+d = tempfile.mkdtemp()
+# 8 requests on 2 slots: when the SIGTERM lands after the first result
+# line, most of the batch is still in flight/queued — the drain must
+# finish ALL of it (generous --drain_timeout) and exit 0
+reqs = os.path.join(d, "requests.jsonl")
+with open(reqs, "w") as f:
+    for i in range(8):
+        f.write(json.dumps({"prompt": "abcd"[: 1 + i % 4],
+                            "max_new_tokens": 6 + i % 4,
+                            "ignore_eos": True, "seed": i}) + "\n")
+out = os.path.join(d, "results.jsonl")
+mj = os.path.join(d, "metrics.jsonl")
+proc = subprocess.Popen(
+    [sys.executable, "-m", "building_llm_from_scratch_tpu",
+     "--mode", "serve", "--debug", "--byte_tokenizer", "--data_dir", d,
+     "--serve_prompts", reqs, "--serve_out", out,
+     "--serve_slots", "2", "--serve_max_queue", "8",
+     "--drain_timeout", "120", "--metrics_jsonl", mj],
+    stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    env=dict(os.environ, JAX_PLATFORMS="cpu"))
+deadline = time.monotonic() + 300
+signaled = False
+while time.monotonic() < deadline:
+    if proc.poll() is not None:
+        break                      # finished before we could preempt it
+    if os.path.exists(out) and open(out).read().count("\n") >= 1:
+        proc.send_signal(signal.SIGTERM)   # preempt mid-serve
+        signaled = True
+        break
+    time.sleep(0.05)
+stdout, _ = proc.communicate(timeout=300)
+assert proc.returncode == 0, f"serve rc={proc.returncode}:\n{stdout}"
+results = [json.loads(l) for l in open(out)]
+assert len(results) == 8, f"expected 8 result lines, got {len(results)}"
+bad = [r for r in results if "error" in r]
+assert not bad, f"drain lost/preempted requests: {bad}"
+rows = [json.loads(l) for l in open(mj)]
+events = [r.get("event") for r in rows if r.get("type") == "event"]
+if signaled:
+    assert "preemption_signal" in events, events
+    assert "drain" in events, "no drain event in the JSONL"
+else:
+    # rare: all 8 requests finished between two 0.05s polls, so no
+    # SIGTERM landed — the completeness + zero-recompile asserts above
+    # still hold; skip only the signal-dependent ones
+    print("note: serve finished before SIGTERM could land; "
+          "drain-event asserts skipped this run")
+recompiles = [r for r in rows if r.get("event") == "recompile"]
+assert not recompiles, f"recompile during drained serve: {recompiles}"
+print(f"drain smoke ok (signaled={signaled}): {len(results)} results all "
+      "complete, clean exit 0, 0 recompiles")
+EOF
+
 echo "== tier-1 tests (ROADMAP.md) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

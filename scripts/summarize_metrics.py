@@ -148,7 +148,15 @@ def summarize_serving(metrics, events):
     metric rows, and the decode token rate."""
     done = [e for e in events if e["event"] == "request_done"]
     rejected = [e for e in events if e["event"] == "request_rejected"]
-    if not (done or rejected):
+    failed = [e for e in events if e["event"] == "request_failed"]
+    shed = [e for e in events if e["event"] == "request_shed"]
+    expired = [e for e in events if e["event"] == "request_expired"]
+    # incident runs can restart/drain/die before ANY request completes —
+    # those are exactly the files this section must explain, so lifecycle
+    # events open the section too, not just request-level ones
+    lifecycle = [e for e in events
+                 if e["event"] in ("engine_restart", "drain", "serve_error")]
+    if not (done or rejected or failed or shed or expired or lifecycle):
         return
     print("\n-- serving --")
     reasons = {}
@@ -160,6 +168,7 @@ def summarize_serving(metrics, events):
           + ", ".join(f"{k} x{v}" for k, v in sorted(reasons.items()))
           + (f"; {len(rejected)} REJECTED over capacity" if rejected
              else "") + ")")
+    summarize_serving_resilience(failed, shed, expired, events)
     for key, label in (("queue_wait_s", "queue wait"), ("ttft_s", "TTFT"),
                        ("tpot_s", "TPOT"), ("e2e_s", "end-to-end")):
         vals = [e[key] for e in done
@@ -187,6 +196,58 @@ def summarize_serving(metrics, events):
         print(f"  !! {summaries[-1]['n_recompiles']} RECOMPILES after "
               "warmup — prompt lengths outside the warmed bucket set "
               "(see the recompile events' leaf diffs)")
+
+
+def summarize_serving_resilience(failed, shed, expired, events):
+    """Resilience telemetry: per-reason request failures (fault isolation
+    — a poison request fails ALONE), SLO sheds + queue TTL expiries
+    (deadline-aware admission), supervisor restarts, and drain summaries.
+    """
+    if failed:
+        by_reason = {}
+        for e in failed:
+            by_reason[e.get("reason")] = by_reason.get(
+                e.get("reason"), 0) + 1
+        print(f"  {len(failed)} requests FAILED: "
+              + ", ".join(f"{k} x{v}"
+                          for k, v in sorted(by_reason.items())))
+    if shed or expired:
+        parts = []
+        if shed:
+            ests = [e["estimated_e2e_s"] for e in shed
+                    if isinstance(e.get("estimated_e2e_s"), (int, float))]
+            parts.append(f"{len(shed)} shed at submit (SLO)"
+                         + (f", est e2e up to {max(ests):.2f}s"
+                            if ests else ""))
+        if expired:
+            waits = [e["queue_wait_s"] for e in expired
+                     if isinstance(e.get("queue_wait_s"), (int, float))]
+            parts.append(f"{len(expired)} expired in queue (TTL)"
+                         + (f", waited up to {max(waits):.2f}s"
+                            if waits else ""))
+        print("  deadline admission: " + "; ".join(parts)
+              + " — clients got fast 429/504s instead of stale results")
+    restarts = [e for e in events if e["event"] == "engine_restart"]
+    if restarts:
+        last = restarts[-1]
+        print(f"  !! {len(restarts)} ENGINE RESTART(S) "
+              f"(last: {last.get('reason')}, "
+              f"{last.get('n_inflight_failed', 0)} in-flight failed, "
+              f"restart {last.get('n_restart')}/"
+              f"{last.get('max_restarts')}) — see the stall events' "
+              "flight records (thread stacks + device memory)")
+    drains = [e for e in events if e["event"] == "drain"
+              and e.get("phase") == "end"]
+    if drains:
+        d = drains[-1]
+        print(f"  drain: completed in {d.get('seconds')}s, "
+              f"{d.get('n_preempted', 0)} preempted "
+              f"({d.get('requests_finished', '?')} requests finished "
+              "before stop)")
+    errors = [e for e in events if e["event"] == "serve_error"]
+    if errors:
+        print(f"  !! ENGINE DIED: {errors[-1].get('error')} "
+              f"({errors[-1].get('n_failed', 0)} requests failed)")
 
 
 def summarize_compile(metrics, events):

@@ -270,18 +270,22 @@ def test_finish_during_admission_does_not_strand_queue(model):
 
 
 def test_engine_loop_death_fails_requests_instead_of_hanging(model):
-    """An exception escaping step() on the background thread (here: a
-    raising on_token callback) must fail the in-flight AND queued requests
-    — result() raises, shutdown() returns — not strand them forever."""
+    """A BATCH-WIDE exception escaping step() on the background thread
+    (here: the decode program itself dying) must fail the in-flight AND
+    queued requests — result() raises, shutdown() returns — not strand
+    them forever. (Per-REQUEST faults like a raising on_token callback no
+    longer reach this path: they are isolated — see
+    test_serving_resilience.py.)"""
     cfg, params = model
     eng = DecodeEngine(cfg, params, n_slots=1, max_len=64, max_queue=8)
 
-    def bad_callback(req, tok, piece):
-        raise RuntimeError("boom from user callback")
+    def bad_decode(*a, **kw):
+        raise RuntimeError("decode program died")
 
+    eng._decode = bad_decode
     sp = SamplingParams(max_new_tokens=4, ignore_eos=True)
     p = np.array([2, 3, 4], np.int32)
-    h_bad = eng.submit(p, sp, on_token=bad_callback)
+    h_bad = eng.submit(p, sp)
     h_queued = eng.submit(p, sp)
     eng.start()
     with pytest.raises(RuntimeError, match="engine loop error"):
@@ -320,11 +324,16 @@ def test_terminal_bucket_warmed_when_max_len_not_multiple_of_64(model):
     assert eng.n_recompiles == 0
 
 
-def test_streaming_and_callbacks(model):
-    cfg, params = model
+def test_streaming_and_callbacks():
+    # byte-vocab config: ByteTokenizer ids run 0..256, so the module
+    # fixture's vocab-96 model would make "abc" (bytes 97-99) an
+    # out-of-vocab poison prompt — which submit now REJECTS (see
+    # test_out_of_vocab_prompt_rejected in test_serving_resilience.py)
     from building_llm_from_scratch_tpu.data.tokenizers import ByteTokenizer
 
     tok = ByteTokenizer()
+    cfg = tiny_cfg(vocab_size=tok.vocab_size)
+    params = init_params(cfg, jax.random.PRNGKey(0))
     eng = DecodeEngine(cfg, params, tokenizer=tok, n_slots=1, max_len=64)
     seen = []
     h = eng.submit("abc", SamplingParams(max_new_tokens=5,

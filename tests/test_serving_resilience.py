@@ -1,0 +1,561 @@
+"""Serving resilience tests (the serving counterpart of PR 1's training
+fault-injection suite): deadline-aware admission (queue TTL expiry, SLO
+shed math), per-request fault isolation (a poison request fails ALONE and
+co-residents' tokens stay bit-identical to a fault-free run), the
+in-graph non-finite-logit guard, the tick-watchdog supervisor
+(restart-then-serve with zero recompiles), graceful drain, and the HTTP
+frontend's input hardening.
+"""
+
+import http.client
+import json
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from building_llm_from_scratch_tpu.configs import ModelConfig
+from building_llm_from_scratch_tpu.generate import generate
+from building_llm_from_scratch_tpu.models import init_params
+from building_llm_from_scratch_tpu.serving import (
+    DecodeEngine,
+    EngineDrainingError,
+    FaultHooks,
+    RequestExpiredError,
+    SLOShedError,
+    SamplingParams,
+)
+
+
+def tiny_cfg(ctx=64, **kw):
+    base = dict(name="serve-resil-tiny", vocab_size=96, context_length=ctx,
+                emb_dim=32, n_heads=2, n_layers=2, hidden_dim=64,
+                n_kv_groups=2, norm="layernorm", positional="learned",
+                activation="gelu", drop_rate=0.0, eos_id=1)
+    base.update(kw)
+    return ModelConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_cfg()
+    return cfg, init_params(cfg, jax.random.PRNGKey(0))
+
+
+def solo_tokens(params, cfg, prompt, sp: SamplingParams):
+    out, n = generate(params, cfg, np.asarray(prompt)[None],
+                      max_new_tokens=sp.max_new_tokens,
+                      temperature=sp.temperature, top_k=sp.top_k,
+                      eos_id=(None if sp.ignore_eos
+                              else (sp.eos_id if sp.eos_id is not None
+                                    else cfg.eos_id)),
+                      rng=jax.random.PRNGKey(sp.seed),
+                      return_n_generated=True)
+    Tp = len(prompt)
+    return [int(t) for t in out[0, Tp: Tp + int(n[0])]]
+
+
+# ---------------------------------------------------------------------------
+# deadline-aware admission
+# ---------------------------------------------------------------------------
+
+def test_queue_ttl_expiry_sheds_at_admission(model):
+    """A queued request whose deadline passes is shed at the admission
+    boundary — ``result()`` raises ``RequestExpiredError``, no slot or
+    decode tick is spent on it."""
+    cfg, params = model
+    eng = DecodeEngine(cfg, params, n_slots=1, max_len=64)
+    p = np.array([5, 6, 7], np.int32)
+    h = eng.submit(p, SamplingParams(max_new_tokens=4, ignore_eos=True,
+                                     deadline_s=0.05))
+    time.sleep(0.12)
+    ticks_before = eng.n_ticks
+    eng.run_until_idle()
+    assert h.done and h.finish_reason == "expired"
+    with pytest.raises(RequestExpiredError, match="expired"):
+        h.result(timeout=1)
+    assert eng.requests_expired == 1
+    assert eng.n_ticks == ticks_before        # zero decode spent on it
+    # a request with a live deadline sails through
+    h2 = eng.submit(p, SamplingParams(max_new_tokens=3, ignore_eos=True,
+                                      deadline_s=60.0))
+    eng.run_until_idle()
+    assert h2.result().finish_reason == "length"
+
+
+def test_slo_shed_decision_math(model):
+    """submit() sheds exactly when queue position x the TPOT-EWMA service
+    estimate + the request's own budget exceeds its deadline."""
+    cfg, params = model
+    eng = DecodeEngine(cfg, params, n_slots=2, max_len=64)
+    # no history yet: estimates are None, admission stays optimistic
+    assert eng.estimate_completion_s(4, 5) is None
+    eng._tpot_ewma = 0.1
+    eng._tokens_ewma = 10.0
+    # wait = (depth/slots) * (tokens * tpot); own decode = max_new * tpot
+    assert eng.estimate_completion_s(4, 5) == pytest.approx(2.5)
+    assert eng.estimate_completion_s(0, 5) == pytest.approx(0.5)
+    # fill the queue without stepping, then probe the shed boundary
+    p = np.array([2, 3], np.int32)
+    for _ in range(3):
+        eng.submit(p, SamplingParams(max_new_tokens=2, ignore_eos=True))
+    est = eng.estimate_completion_s(3, 2)      # (3/2)*1.0 + 0.2 = 1.7
+    assert est == pytest.approx(1.7)
+    with pytest.raises(SLOShedError) as ei:
+        eng.submit(p, SamplingParams(max_new_tokens=2, ignore_eos=True,
+                                     deadline_s=1.0))
+    assert ei.value.retry_after_s and ei.value.retry_after_s > 0
+    assert eng.requests_shed == 1
+    # same request with a meetable deadline is admitted
+    h = eng.submit(p, SamplingParams(max_new_tokens=2, ignore_eos=True,
+                                     deadline_s=60.0))
+    eng.run_until_idle()
+    assert h.result().finish_reason == "length"
+    assert eng.requests_shed == 1              # no extra sheds
+    # in-flight requests count toward the wait (half-done on average):
+    # full slots + empty queue must NOT predict zero wait
+    eng._tpot_ewma, eng._tokens_ewma = 0.1, 10.0   # re-pin post-run EWMAs
+    for _ in range(2):
+        eng.submit(p, SamplingParams(max_new_tokens=10, ignore_eos=True))
+    eng.step()                                 # admit both into slots
+    assert eng.scheduler.n_active == 2
+    # wait = ((0 + 0.5*2)/2) * 1.0 = 0.5; own budget = 5*0.1
+    assert eng.estimate_completion_s(0, 5) == pytest.approx(1.0)
+
+
+# ---------------------------------------------------------------------------
+# per-request fault isolation
+# ---------------------------------------------------------------------------
+
+def test_poison_prefill_fails_alone_coresidents_bit_identical(model):
+    """THE isolation contract: a poison request (injected prefill fault)
+    fails alone, and its co-residents' token streams are bit-identical to
+    a fault-free run of the same traffic."""
+    cfg, params = model
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(2, cfg.vocab_size, (4 + i,)).astype(np.int32)
+               for i in range(3)]
+    sps = [SamplingParams(max_new_tokens=6 + i, seed=i, ignore_eos=True,
+                          temperature=0.8 * (i % 2), top_k=9 if i % 2
+                          else None)
+           for i in range(3)]
+
+    # fault-free reference run
+    eng_ref = DecodeEngine(cfg, params, n_slots=3, max_len=64)
+    ref = [eng_ref.submit(p, sp) for p, sp in zip(prompts, sps)]
+    eng_ref.run_until_idle()
+    ref_tokens = [h.output_ids for h in ref]
+
+    # same traffic + a poison request admitted mid-stream
+    poison_ids = set()
+
+    class Hooks(FaultHooks):
+        def before_prefill(self, req):
+            if req.id in poison_ids:
+                raise RuntimeError("injected prefill fault")
+
+    eng = DecodeEngine(cfg, params, n_slots=3, max_len=64, hooks=Hooks())
+    h0 = eng.submit(prompts[0], sps[0])
+    assert eng.step()                          # request 0 decodes alone
+    hp = eng.submit(np.array([9, 9, 9], np.int32),
+                    SamplingParams(max_new_tokens=8, ignore_eos=True))
+    poison_ids.add(hp.id)
+    h1 = eng.submit(prompts[1], sps[1])
+    h2 = eng.submit(prompts[2], sps[2])
+    eng.run_until_idle()
+
+    # poison failed alone ...
+    assert hp.done and hp.finish_reason == "error"
+    assert "prefill" in hp.error
+    with pytest.raises(RuntimeError, match="failed"):
+        hp.result(timeout=1)
+    assert eng.requests_failed == 1
+    # ... the engine is alive (not _fail_all'd), its slot was freed ...
+    assert eng._dead is None
+    assert eng.scheduler.n_active == 0 and len(eng.queue) == 0
+    # ... and the co-residents are BIT-IDENTICAL to the fault-free run
+    for h, want, p, sp in zip((h0, h1, h2), ref_tokens, prompts, sps):
+        assert h.finish_reason == "length"
+        assert h.output_ids == want
+        assert h.output_ids == solo_tokens(params, cfg, p, sp)
+
+
+def test_raising_on_token_callback_fails_request_alone(model):
+    """A raising client callback is the REQUEST's fault, not the
+    engine's: it fails alone, co-resident and queued requests finish."""
+    cfg, params = model
+    eng = DecodeEngine(cfg, params, n_slots=2, max_len=64, max_queue=8)
+
+    def bad_callback(req, tok, piece):
+        raise RuntimeError("boom from user callback")
+
+    sp = SamplingParams(max_new_tokens=4, ignore_eos=True)
+    p = np.array([2, 3, 4], np.int32)
+    h_bad = eng.submit(p, sp, on_token=bad_callback)
+    h_ok = eng.submit(p, sp)
+    h_queued = eng.submit(p, sp)
+    eng.run_until_idle()
+    assert h_bad.finish_reason == "error" and "callback" in h_bad.error
+    assert h_ok.result().output_ids == solo_tokens(params, cfg, p, sp)
+    assert h_queued.result().output_ids == solo_tokens(params, cfg, p, sp)
+    assert eng._dead is None                   # engine survived
+    assert eng.requests_failed == 1
+
+
+def test_non_finite_logits_retire_slot_not_batch(model):
+    """NaN-poisoned KV state (injected) makes ONE row's logits non-finite
+    in-graph; the guard retires that slot with an error status while the
+    co-resident request's tokens stay bit-identical — and the poisoned
+    slot serves cleanly on reuse. Zero recompiles throughout."""
+    cfg, params = model
+    poison_ids = set()
+
+    class Hooks(FaultHooks):
+        def poison_nan(self, req):
+            return req.id in poison_ids
+
+    eng = DecodeEngine(cfg, params, n_slots=2, max_len=64, hooks=Hooks())
+    eng.warmup()
+    pa = np.array([5, 6, 7, 8], np.int32)
+    sa = SamplingParams(max_new_tokens=6, seed=3, ignore_eos=True,
+                        temperature=1.0, top_k=7)
+    ha = eng.submit(pa, sa)
+    hp = eng.submit(np.array([4, 4], np.int32),
+                    SamplingParams(max_new_tokens=6, ignore_eos=True))
+    poison_ids.add(hp.id)
+    eng.run_until_idle()
+    assert hp.done and hp.finish_reason == "error"
+    assert "non-finite" in hp.error
+    assert len(hp.output_ids) <= 1             # prefill token at most
+    assert ha.result().output_ids == solo_tokens(params, cfg, pa, sa)
+    # the poisoned slot is safe to reuse: prefill overwrites its rows and
+    # per-slot masking hides the stale NaN tail
+    poison_ids.clear()
+    h2 = eng.submit(pa, sa)
+    eng.run_until_idle()
+    assert h2.result().output_ids == solo_tokens(params, cfg, pa, sa)
+    assert eng.n_recompiles == 0               # CompileWatcher-asserted
+
+
+def test_out_of_vocab_prompt_rejected_at_submit(model):
+    """Out-of-vocab prompt ids would embed as NaN and stream garbage —
+    submit() rejects them before they cost a slot."""
+    cfg, params = model
+    eng = DecodeEngine(cfg, params, n_slots=1, max_len=64)
+    with pytest.raises(ValueError, match="token ids"):
+        eng.submit(np.array([5, cfg.vocab_size], np.int32),
+                   SamplingParams(max_new_tokens=2))
+    with pytest.raises(ValueError, match="token ids"):
+        eng.submit(np.array([-1, 5], np.int32),
+                   SamplingParams(max_new_tokens=2))
+
+
+# ---------------------------------------------------------------------------
+# tick-watchdog supervisor
+# ---------------------------------------------------------------------------
+
+def test_hung_tick_flight_record_restart_then_serve(model, tmp_path):
+    """A wedged tick trips the watchdog: flight record (``stall`` event),
+    in-flight requests fail, the loop restarts with bounded backoff
+    (``engine_restart`` event), queued work is KEPT, and the engine
+    serves new requests afterwards — with zero recompiles (the compiled
+    programs and their frozen CompileWatchers survive the restart)."""
+    from building_llm_from_scratch_tpu.obs.metrics import configure_metrics
+
+    cfg, params = model
+    hang = threading.Event()        # set => wedge the next tick
+    release = threading.Event()     # un-wedge the abandoned thread
+
+    class Hooks(FaultHooks):
+        def before_tick(self, engine):
+            if hang.is_set():
+                hang.clear()
+                release.wait(30)    # the simulated wedge (bounded)
+
+        def after_token(self, req, tok):
+            # slow-client drag stretches the decode so the wedge lands
+            # mid-request deterministically (a 40-token burst on the CPU
+            # backend can otherwise outrun the test's hang.set())
+            time.sleep(0.005)
+
+    mj = str(tmp_path / "restart_metrics.jsonl")
+    sink = configure_metrics(mj)
+    sink.write_header(test="restart")
+    try:
+        eng = DecodeEngine(cfg, params, n_slots=2, max_len=64,
+                           hooks=Hooks(), tick_timeout_s=0.6,
+                           max_restarts=2, restart_backoff_s=0.05)
+        eng.warmup()
+        eng.start()
+        p = np.array([5, 6, 7], np.int32)
+        sp_long = SamplingParams(max_new_tokens=60, ignore_eos=True)
+        h1 = eng.submit(p, sp_long)
+        deadline = time.monotonic() + 20
+        while not h1.output_ids and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert h1.output_ids                   # mid-decode
+        hang.set()
+        with pytest.raises(RuntimeError, match="restarted"):
+            h1.result(timeout=30)
+        assert h1.finish_reason == "error"
+        assert eng.n_restarts == 1
+        # the engine serves NEW traffic after the restart
+        sp_new = SamplingParams(max_new_tokens=5, seed=2, ignore_eos=True)
+        h2 = eng.submit(p, sp_new)
+        h2.result(timeout=30)
+        assert h2.output_ids == solo_tokens(params, cfg, p, sp_new)
+        release.set()                          # un-wedge the old thread
+        time.sleep(0.1)                        # let it observe the bump
+        # the abandoned thread must have committed NOTHING: serve again
+        h3 = eng.submit(p, sp_new)
+        h3.result(timeout=30)
+        assert h3.output_ids == h2.output_ids
+        assert eng.n_recompiles == 0           # CompileWatcher-asserted
+        eng.shutdown()
+    finally:
+        release.set()
+        sink.close()
+        configure_metrics(None)
+    rows = [json.loads(line) for line in open(mj)]
+    events = [r.get("event") for r in rows if r.get("type") == "event"]
+    assert "stall" in events                   # the flight record fired
+    restarts = [r for r in rows if r.get("event") == "engine_restart"]
+    assert len(restarts) == 1
+    assert restarts[0]["reason"] == "hung_tick"
+    assert restarts[0]["n_inflight_failed"] == 1
+    failed = [r for r in rows if r.get("event") == "request_failed"]
+    assert any(r.get("reason") == "engine_restart" for r in failed)
+    assert not [r for r in rows if r.get("event") == "recompile"]
+
+
+def test_restart_budget_exhaustion_fails_engine(model):
+    """Restarts are bounded: past ``max_restarts`` the engine dies loudly
+    (every caller unblocked) instead of flapping forever."""
+    cfg, params = model
+    eng = DecodeEngine(cfg, params, n_slots=1, max_len=64,
+                       tick_timeout_s=5.0, max_restarts=1,
+                       restart_backoff_s=0.01)
+    eng.n_restarts = 1                         # budget already spent
+    assert eng._restart(reason="hung_tick") is False
+
+
+# ---------------------------------------------------------------------------
+# graceful drain
+# ---------------------------------------------------------------------------
+
+def test_drain_completes_in_flight_and_closes_admission(model):
+    cfg, params = model
+    eng = DecodeEngine(cfg, params, n_slots=1, max_len=64)
+    eng.start()
+    p = np.array([5, 6, 7], np.int32)
+    h1 = eng.submit(p, SamplingParams(max_new_tokens=20, ignore_eos=True))
+    h2 = eng.submit(p, SamplingParams(max_new_tokens=5, ignore_eos=True))
+    deadline = time.monotonic() + 20
+    while not h1.output_ids and time.monotonic() < deadline:
+        time.sleep(0.01)
+    summary = eng.drain(timeout=60.0)          # generous: everything lands
+    assert summary["n_preempted"] == 0
+    assert h1.result().finish_reason == "length"
+    assert len(h1.output_ids) == 20
+    assert h2.result().finish_reason == "length"   # queued work finishes too
+    assert eng.draining
+    with pytest.raises(EngineDrainingError):
+        eng.submit(p, SamplingParams(max_new_tokens=2))
+    eng.shutdown()
+
+
+def test_drain_timeout_preempts_remainder(model):
+    cfg, params = model
+
+    class SlowClient(FaultHooks):
+        def after_token(self, req, tok):
+            time.sleep(0.01)       # the tiny CPU model would otherwise
+                                   # finish 50 tokens inside any timeout
+
+    eng = DecodeEngine(cfg, params, n_slots=1, max_len=64,
+                       hooks=SlowClient())
+    p = np.array([5, 6, 7], np.int32)
+    h1 = eng.submit(p, SamplingParams(max_new_tokens=50, ignore_eos=True))
+    h2 = eng.submit(p, SamplingParams(max_new_tokens=50, ignore_eos=True))
+    for _ in range(3):
+        assert eng.step()
+    summary = eng.drain(timeout=0.05)          # nowhere near enough
+    assert summary["n_preempted"] == 2
+    for h in (h1, h2):
+        assert h.done and h.finish_reason == "preempted"
+        with pytest.raises(RuntimeError, match="preempted"):
+            h.result(timeout=1)
+    assert eng.scheduler.n_active == 0 and len(eng.queue) == 0
+
+
+def test_serve_jsonl_streams_every_completed_line_across_drain(model,
+                                                               tmp_path):
+    """The zero-loss drain contract: a drain mid-batch still ends with
+    one line per request on disk, every completed request's tokens
+    intact (here the budget is generous, so ALL complete)."""
+    from building_llm_from_scratch_tpu.serving.frontend import serve_jsonl
+
+    cfg, params = model
+    eng = DecodeEngine(cfg, params, n_slots=1, max_len=64, max_queue=8)
+    eng.start()
+    reqs = tmp_path / "reqs.jsonl"
+    with open(reqs, "w") as f:
+        for i in range(4):
+            f.write(json.dumps({"prompt_ids": [5, 6, 7],
+                                "max_new_tokens": 8 + i,
+                                "ignore_eos": True, "seed": i}) + "\n")
+    out = tmp_path / "results.jsonl"
+    worker = threading.Thread(
+        target=serve_jsonl, args=(eng, str(reqs), str(out), 8),
+        daemon=True)
+    worker.start()
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if out.exists() and out.read_text().count("\n") >= 1:
+            break
+        time.sleep(0.01)
+    eng.drain(timeout=60.0)                    # mid-batch, generous budget
+    worker.join(timeout=30)
+    assert not worker.is_alive()
+    lines = [json.loads(line) for line in open(out)]
+    assert len(lines) == 4
+    for i, rec in enumerate(lines):
+        assert "error" not in rec, rec
+        assert rec["finish_reason"] == "length"
+        assert rec["n_tokens"] == 8 + i
+    eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# HTTP frontend hardening
+# ---------------------------------------------------------------------------
+
+def _post(port, body: bytes, timeout=30, path="/generate"):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    conn.request("POST", path, body=body,
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    payload = json.loads(resp.read() or b"{}")
+    headers = dict(resp.getheaders())
+    conn.close()
+    return resp.status, payload, headers
+
+
+def test_http_hardening_and_drain_status(model):
+    from building_llm_from_scratch_tpu.serving.frontend import (
+        make_http_server,
+    )
+
+    cfg, params = model
+    eng = DecodeEngine(cfg, params, n_slots=1, max_len=64)
+    eng.start()
+    server = make_http_server(eng, 0, host="127.0.0.1",
+                              max_body_bytes=512)
+    port = server.server_address[1]
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        # oversized body: 413 without reading it
+        status, out, _ = _post(port, b"x" * 600)
+        assert status == 413 and "limit" in out["error"]
+        # malformed JSON: 400, not a handler traceback
+        status, out, _ = _post(port, b"{not json")
+        assert status == 400
+        # well-formed JSON that is not an object: 400
+        status, out, _ = _post(port, b"[1, 2, 3]")
+        assert status == 400 and "object" in out["error"]
+        # mistyped field: 400
+        status, out, _ = _post(
+            port, json.dumps({"prompt_ids": [5], "top_k": {}}).encode())
+        assert status == 400
+        # out-of-vocab prompt ids: 400
+        status, out, _ = _post(
+            port, json.dumps({"prompt_ids": [5, 4000],
+                              "max_new_tokens": 2}).encode())
+        assert status == 400 and "token ids" in out["error"]
+        # healthy request still works
+        status, out, _ = _post(
+            port, json.dumps({"prompt_ids": [5, 6], "max_new_tokens": 2,
+                              "ignore_eos": True}).encode())
+        assert status == 200 and len(out["token_ids"]) == 2
+        # healthz reflects drain state; draining POST -> 503 + Retry-After
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        conn.request("GET", "/healthz")
+        health = json.loads(conn.getresponse().read())
+        conn.close()
+        assert health["status"] == "serving" and not health["draining"]
+        eng.drain(timeout=5.0)
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        conn.request("GET", "/healthz")
+        health = json.loads(conn.getresponse().read())
+        conn.close()
+        assert health["status"] == "draining" and health["draining"]
+        status, out, headers = _post(
+            port, json.dumps({"prompt_ids": [5, 6],
+                              "max_new_tokens": 2}).encode())
+        assert status == 503
+        assert "Retry-After" in headers
+    finally:
+        server.shutdown()
+        server.server_close()
+        eng.shutdown()
+
+
+def test_http_timeout_cancels_request_and_frees_slot(model):
+    """A handler timeout must CANCEL the request: its slot stops decoding
+    (today's bug: a timed-out handle kept decoding to max_new_tokens)."""
+    from building_llm_from_scratch_tpu.serving.frontend import (
+        make_http_server,
+    )
+
+    cfg, params = model
+
+    class SlowClient(FaultHooks):
+        def after_token(self, req, tok):
+            time.sleep(0.01)       # stretch ticks so 50 tokens >> 0.2s
+
+    eng = DecodeEngine(cfg, params, n_slots=1, max_len=64,
+                       hooks=SlowClient())
+    eng.warmup()                   # prepay compiles: the 2-token success
+    eng.start()                    # path below must beat the 0.2s timeout
+    server = make_http_server(eng, 0, host="127.0.0.1",
+                              request_timeout_s=0.2)
+    port = server.server_address[1]
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        status, out, _ = _post(
+            port, json.dumps({"prompt_ids": [5, 6], "max_new_tokens": 50,
+                              "ignore_eos": True}).encode())
+        assert status == 504
+        # the cancel retires the slot at the next tick boundary — long
+        # before the 50-token budget would have
+        deadline = time.monotonic() + 10
+        while eng.scheduler.n_active and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert eng.scheduler.n_active == 0
+        assert eng.requests_failed >= 1
+        # and the engine keeps serving
+        status, out, _ = _post(
+            port, json.dumps({"prompt_ids": [5, 6], "max_new_tokens": 2,
+                              "ignore_eos": True}).encode())
+        assert status == 200 and len(out["token_ids"]) == 2
+    finally:
+        server.shutdown()
+        server.server_close()
+        eng.shutdown()
+
+
+def test_cancel_queued_request_immediate(model):
+    cfg, params = model
+    eng = DecodeEngine(cfg, params, n_slots=1, max_len=64)
+    p = np.array([5, 6], np.int32)
+    h1 = eng.submit(p, SamplingParams(max_new_tokens=3, ignore_eos=True))
+    h2 = eng.submit(p, SamplingParams(max_new_tokens=3, ignore_eos=True))
+    assert eng.cancel(h2)                      # still queued: immediate
+    assert h2.done and h2.finish_reason == "cancelled"
+    eng.run_until_idle()
+    assert h1.result().finish_reason == "length"
+    assert eng.cancel(h1) is False             # already done
