@@ -24,6 +24,7 @@ recorded in the metric name) — the full-size sharding compiles+executes in
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -488,10 +489,11 @@ def bench_serve(n_requests=8, max_new=32, prompt_len=16):
         for h in handles:
             assert len(h.output_ids) == max_new, h.finish_reason
         tok_s = n_requests * max_new / dt
+        e2e_pct = engine.e2e_hist.percentiles((50, 99))
         detail[f"engine_slots{slots}"] = {
             "tok_s": round(tok_s, 1),
-            "p50_s": round(float(np.percentile(engine.e2e_hist, 50)), 4),
-            "p99_s": round(float(np.percentile(engine.e2e_hist, 99)), 4),
+            "p50_s": e2e_pct.get("p50"),
+            "p99_s": e2e_pct.get("p99"),
             "vs_sequential": round(tok_s / seq_tok_s, 2),
             "recompiles": engine.n_recompiles,
         }
@@ -519,7 +521,14 @@ def bench_serve_load(n_slots=4, max_new=24, prompt_len=16,
     per arm: offered/completed rps, shed/expired/rejected counts, and
     TTFT/TPOT/e2e percentiles — the latency-vs-throughput curve.
 
+    Each arm writes its own metrics JSONL (reported as
+    ``metrics_jsonl`` in the arm detail), so the per-arm tick-phase
+    breakdown, request span trees and SLO burn are renderable after the
+    fact: ``python scripts/summarize_metrics.py <arm.jsonl> --trace
+    <arm.trace.json>``.
+
     fp32 on CPU, bf16 on TPU (same policy as ``bench_serve``)."""
+    import tempfile
     import time
 
     from building_llm_from_scratch_tpu.configs import get_config
@@ -543,10 +552,14 @@ def bench_serve_load(n_slots=4, max_new=24, prompt_len=16,
                            (n_requests, prompt_len)).astype(np.int32)
 
     def new_engine():
+        # metrics_every=8: short arms still emit tick-breakdown cadence
+        # rows into their per-arm JSONL (the default 32 would leave a
+        # small sweep with request events but no tick phases)
         eng = DecodeEngine(cfg, params, n_slots=n_slots,
                            max_len=_bucket(prompt_len + max_new),
                            max_queue=max(2 * n_slots, 16),
-                           warmup_prompt_cap=prompt_len)
+                           warmup_prompt_cap=prompt_len,
+                           metrics_every=8)
         eng.warmup()
         return eng
 
@@ -565,9 +578,18 @@ def bench_serve_load(n_slots=4, max_new=24, prompt_len=16,
 
     deadline_s = deadline_factor * solo_s
     completed_at_1x = 0.0
+    from building_llm_from_scratch_tpu.obs import configure_metrics
+
+    jsonl_dir = tempfile.mkdtemp(prefix="bench_serve_load_")
     for load in (0.5, 1.0, 1.5):
         lam = load * cap_rps                 # offered arrival rate
         arrivals = np.cumsum(rng.exponential(1.0 / lam, n_requests))
+        # one telemetry file per arm: tick breakdown / span trees / SLO
+        # burn stay attributable to THIS offered-load point
+        arm_jsonl = os.path.join(jsonl_dir, f"load_{load:g}x.jsonl")
+        configure_metrics(arm_jsonl, run_metadata={
+            "bench": "serve_load", "offered_load_x": load,
+            "n_slots": n_slots, "n_requests": n_requests})
         eng = new_engine()
         eng.start()
         handles, shed, rejected = [], 0, 0
@@ -595,6 +617,7 @@ def bench_serve_load(n_slots=4, max_new=24, prompt_len=16,
                 pass
         dt = time.perf_counter() - t0
         eng.shutdown()
+        configure_metrics(None)              # close + detach the arm sink
         stats = eng.stats()
         arm = {
             "offered_rps": round(lam, 3),
@@ -603,6 +626,7 @@ def bench_serve_load(n_slots=4, max_new=24, prompt_len=16,
             "rejected": rejected,
             "shed_rate": round((shed + expired + rejected)
                                / n_requests, 3),
+            "metrics_jsonl": arm_jsonl,
         }
         for key in ("ttft_s", "tpot_s", "e2e_s"):
             if key in stats:

@@ -94,6 +94,9 @@ def perform_checks(args) -> None:
         if args.serve_deadline_s < 0:
             raise ValueError("--serve_deadline_s must be >= 0 "
                              "(0 = no default deadline).")
+        if args.serve_metrics_every < 0:
+            raise ValueError("--serve_metrics_every must be >= 0 "
+                             "(0 disables the tick cadence rows).")
     else:
         # every serve flag, not just the workload pair: a non-default
         # value outside serve mode is a mistyped/missing --mode serve,
@@ -105,7 +108,7 @@ def perform_checks(args) -> None:
             ("serve_max_len", 0), ("serve_max_top_k", 64),
             ("serve_host", "127.0.0.1"), ("drain_timeout", 30.0),
             ("serve_tick_timeout", 0.0), ("serve_max_restarts", 3),
-            ("serve_deadline_s", 0.0),
+            ("serve_deadline_s", 0.0), ("serve_metrics_every", 32),
         ) if getattr(args, name) != default]
         if stray:
             raise ValueError(
@@ -353,6 +356,14 @@ def get_args(argv=None):
                              "504) and admission rejects up front when "
                              "the backlog already predicts a miss (HTTP "
                              "429 + Retry-After). 0 = no default.")
+    parser.add_argument("--serve_metrics_every", type=int, default=32,
+                        help="Engine metrics cadence in decode ticks: "
+                             "each cadence writes one metrics row with "
+                             "the decode rate, occupancy/queue gauges "
+                             "and the per-tick phase breakdown "
+                             "(admit/prefill/decode_dispatch/host_fetch/"
+                             "sample_commit/callback_detok) to "
+                             "--metrics_jsonl. 0 disables.")
 
     # Training configuration
     parser.add_argument("--n_epochs", type=int, default=2,

@@ -17,7 +17,12 @@ Entry points:
   - ``CompileWatcher`` / ``aot_compile`` / ``enable_persistent_cache`` —
     AOT compile capture, HLO cost/memory analysis, recompile detection,
     persistent-cache wiring (obs/compile.py);
-  - ``StallDetector`` — opt-in hung-step flight recorder (obs/stall.py).
+  - ``StallDetector`` — opt-in hung-step flight recorder (obs/stall.py);
+  - ``Histogram`` / ``RollingRatio`` / ``render_prometheus`` — serving
+    aggregation: fixed-bucket latency histograms, rolling SLO burn-rate
+    window, Prometheus text exposition (obs/metrics.py);
+  - ``chrome_trace`` / ``export_chrome_trace`` / ``TICK_PHASES`` —
+    metrics-JSONL -> Chrome trace-event JSON for Perfetto (obs/trace.py).
 """
 
 from building_llm_from_scratch_tpu.obs.compile import (
@@ -33,11 +38,20 @@ from building_llm_from_scratch_tpu.obs.health import (
     health_summary_line,
 )
 from building_llm_from_scratch_tpu.obs.metrics import (
+    LATENCY_BUCKETS_S,
+    Histogram,
     MetricLogger,
+    RollingRatio,
     configure_metrics,
     emit_event,
     get_metrics,
+    render_prometheus,
     run_metadata,
+)
+from building_llm_from_scratch_tpu.obs.trace import (
+    TICK_PHASES,
+    chrome_trace,
+    export_chrome_trace,
 )
 from building_llm_from_scratch_tpu.obs.mfu import (
     compute_mfu,
@@ -57,10 +71,17 @@ from building_llm_from_scratch_tpu.obs.timeline import (
 
 __all__ = [
     "MetricLogger",
+    "Histogram",
+    "RollingRatio",
+    "LATENCY_BUCKETS_S",
+    "render_prometheus",
     "configure_metrics",
     "emit_event",
     "get_metrics",
     "run_metadata",
+    "TICK_PHASES",
+    "chrome_trace",
+    "export_chrome_trace",
     "compute_mfu",
     "device_peak_flops",
     "device_specs",

@@ -24,6 +24,12 @@ JSONL schema (one JSON object per line, ``type`` discriminates):
   - ``event``   — typed structured events (``event`` names the kind:
     checkpoint_save, checkpoint_fallback, preemption_stop, watchdog_halt,
     compile, recompile, retry, stall, ...), with free-form fields.
+  - ``span``    — one closed wall-clock span (v3): ``name``, ``cat``,
+    ``t0`` (unix seconds), ``dur_s``, optional nested ``children``
+    (same shape, no further nesting) and correlation fields
+    (``request_id``...). The serving engine emits one span row per
+    request at its terminal state; ``obs/trace.py`` renders span rows
+    (plus metric/event rows) as Chrome trace-event JSON for Perfetto.
 
 One run = one file: if the path already holds a previous run's telemetry
 (a ``--resume auto`` relaunch reuses the same command), the old file is
@@ -43,8 +49,10 @@ watcher thread.
 
 from __future__ import annotations
 
+import bisect
 import json
 import os
+import re
 import sys
 import threading
 import time
@@ -54,7 +62,7 @@ from building_llm_from_scratch_tpu.utils.logging import setup_logger
 
 logger = setup_logger(__name__)
 
-SCHEMA_VERSION = 2          # v2: + "health" row type, compile/recompile events
+SCHEMA_VERSION = 3          # v3: + "span" row type (request/tick tracing)
 
 
 def _is_coordinator() -> bool:
@@ -100,6 +108,195 @@ def _jsonable(value: Any) -> Any:
         except Exception:
             pass
     return repr(value)
+
+
+# ---------------------------------------------------------------------------
+# Serving-grade aggregation: fixed-bucket histograms + rolling SLO window
+# ---------------------------------------------------------------------------
+
+#: Default latency buckets (seconds) for TTFT/TPOT/e2e/queue-wait: log-ish
+#: spacing from 1ms to 2min. Fixed buckets — unlike a reservoir deque, the
+#: memory cost is O(buckets) forever and two scrapes of a long-running
+#: server are COMPARABLE (Prometheus histogram semantics: cumulative
+#: bucket counters, rate()-able).
+LATENCY_BUCKETS_S = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                     0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0)
+
+
+class Histogram:
+    """Thread-safe fixed-bucket histogram (Prometheus semantics).
+
+    ``bounds`` are the buckets' inclusive upper edges; an implicit +Inf
+    bucket catches the tail. ``observe()`` is O(log buckets); state is
+    cumulative and never forgets — this replaces the engine's bounded
+    deque reservoirs, whose percentiles silently covered only the most
+    recent 8192 requests of a long-running server.
+    """
+
+    def __init__(self, bounds=LATENCY_BUCKETS_S):
+        self.bounds = tuple(sorted(float(b) for b in bounds))
+        if not self.bounds:
+            raise ValueError("histogram needs at least one bucket bound")
+        self._counts = [0] * (len(self.bounds) + 1)   # +Inf tail bucket
+        self.count = 0
+        self.sum = 0.0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        i = bisect.bisect_left(self.bounds, value)
+        with self._lock:
+            self._counts[i] += 1
+            self.count += 1
+            self.sum += value
+
+    def __len__(self) -> int:                 # observations, not buckets
+        return self.count
+
+    def snapshot(self) -> Dict[str, Any]:
+        """{"buckets": [(le, cumulative_count), ..., ("+Inf", n)],
+        "count": n, "sum": s} — a consistent point-in-time view."""
+        with self._lock:
+            counts = list(self._counts)
+            total, s = self.count, self.sum
+        cum, out = 0, []
+        for le, c in zip(self.bounds, counts):
+            cum += c
+            out.append((le, cum))
+        out.append(("+Inf", total))
+        return {"buckets": out, "count": total, "sum": s}
+
+    def percentile(self, p: float) -> Optional[float]:
+        """Estimated p-th percentile: linear interpolation inside the
+        target bucket (Prometheus ``histogram_quantile`` semantics; the
+        +Inf bucket clamps to the largest finite bound). None when empty.
+        """
+        with self._lock:
+            counts = list(self._counts)
+            total = self.count
+        if total == 0:
+            return None
+        rank = (p / 100.0) * total
+        cum = 0
+        for i, c in enumerate(counts):
+            if c == 0:
+                continue
+            if cum + c >= rank:
+                if i >= len(self.bounds):     # +Inf bucket: clamp
+                    return self.bounds[-1]
+                lo = self.bounds[i - 1] if i > 0 else 0.0
+                hi = self.bounds[i]
+                frac = (rank - cum) / c
+                return lo + (hi - lo) * frac
+            cum += c
+        return self.bounds[-1]
+
+    def percentiles(self, ps=(50, 95, 99)) -> Dict[str, float]:
+        out = {}
+        for p in ps:
+            v = self.percentile(p)
+            if v is not None:
+                out[f"p{p}"] = round(v, 6)
+        return out
+
+
+class RollingRatio:
+    """Rolling-window hit/miss ratio over wall time (SLO burn rate).
+
+    Time is chopped into ``n_buckets`` sub-windows of the last
+    ``window_s`` seconds; ``observe(miss)`` lands in the current
+    sub-window and expired sub-windows are dropped lazily — so
+    ``ratio()`` always answers "what fraction of deadline-carrying
+    requests missed over the last window", which is the number an
+    SLO-aware router alerts and routes on. O(n_buckets) memory forever.
+    """
+
+    def __init__(self, window_s: float = 300.0, n_buckets: int = 30):
+        if window_s <= 0 or n_buckets < 1:
+            raise ValueError("window_s > 0 and n_buckets >= 1 required")
+        self.window_s = float(window_s)
+        self.bucket_s = self.window_s / int(n_buckets)
+        # bucket index -> [total, misses]
+        self._buckets: Dict[int, list] = {}
+        self._lock = threading.Lock()
+
+    def _expire(self, now: float) -> None:
+        horizon = now - self.window_s
+        dead = [k for k in self._buckets
+                if (k + 1) * self.bucket_s <= horizon]
+        for k in dead:
+            del self._buckets[k]
+
+    def observe(self, miss: bool, now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        k = int(now // self.bucket_s)
+        with self._lock:
+            self._expire(now)
+            b = self._buckets.setdefault(k, [0, 0])
+            b[0] += 1
+            if miss:
+                b[1] += 1
+
+    def counts(self, now: Optional[float] = None) -> tuple:
+        """(total, misses) inside the current window."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._expire(now)
+            total = sum(b[0] for b in self._buckets.values())
+            misses = sum(b[1] for b in self._buckets.values())
+        return total, misses
+
+    def ratio(self, now: Optional[float] = None) -> Optional[float]:
+        total, misses = self.counts(now)
+        if total == 0:
+            return None
+        return misses / total
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition (version 0.0.4; no client library needed)
+# ---------------------------------------------------------------------------
+
+def _prom_name(name: str) -> str:
+    """Sanitize to the Prometheus metric-name charset."""
+    return re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+
+
+def render_prometheus(counters: Dict[str, float],
+                      gauges: Dict[str, float],
+                      histograms: Dict[str, "Histogram"],
+                      prefix: str = "bllm_") -> str:
+    """Render counters/gauges/histograms as Prometheus text exposition
+    (``GET /metrics`` body). Counters get a ``_total`` suffix; histogram
+    series follow the ``_bucket{le=}``/``_sum``/``_count`` convention, so
+    ``histogram_quantile()`` works on them unmodified."""
+    lines = []
+    for name in sorted(counters):
+        v = counters[name]
+        if not isinstance(v, (int, float)):
+            continue
+        n = _prom_name(prefix + name)
+        if not n.endswith("_total"):
+            n += "_total"
+        lines.append(f"# TYPE {n} counter")
+        lines.append(f"{n} {v}")
+    for name in sorted(gauges):
+        v = gauges[name]
+        if not isinstance(v, (int, float)):
+            continue
+        n = _prom_name(prefix + name)
+        lines.append(f"# TYPE {n} gauge")
+        lines.append(f"{n} {v}")
+    for name in sorted(histograms):
+        snap = histograms[name].snapshot()
+        n = _prom_name(prefix + name)
+        lines.append(f"# TYPE {n} histogram")
+        for le, cum in snap["buckets"]:
+            le_txt = "+Inf" if le == "+Inf" else repr(float(le))
+            lines.append(f'{n}_bucket{{le="{le_txt}"}} {cum}')
+        lines.append(f"{n}_sum {snap['sum']}")
+        lines.append(f"{n}_count {snap['count']}")
+    return "\n".join(lines) + "\n"
 
 
 class MetricLogger:
@@ -230,6 +427,25 @@ class MetricLogger:
         row = {"type": "health", "time": time.time(), "step": int(step),
                "groups": list(groups)}
         row.update(arrays)
+        self._write_row(row)
+
+    def log_span(self, name: str, t0: float, dur_s: float,
+                 cat: str = "span", children=None, **fields: Any) -> None:
+        """One closed wall-clock ``span`` row: ``t0`` is unix seconds,
+        ``dur_s`` its duration; ``children`` is an optional list of
+        ``{"name", "t0", "dur_s"}`` sub-spans (one level — the serving
+        request tree is root + phases). Correlation keys (``request_id``)
+        ride as free-form fields; ``obs/trace.py`` joins them."""
+        row: Dict[str, Any] = {"type": "span", "time": time.time(),
+                               "name": name, "cat": cat,
+                               "t0": round(float(t0), 6),
+                               "dur_s": round(float(dur_s), 6)}
+        if children:
+            row["children"] = [
+                {"name": c["name"], "t0": round(float(c["t0"]), 6),
+                 "dur_s": round(float(c["dur_s"]), 6)}
+                for c in children]
+        row.update(fields)
         self._write_row(row)
 
     def event(self, kind: str, step: Optional[int] = None,

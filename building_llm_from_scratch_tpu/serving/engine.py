@@ -48,7 +48,6 @@ fault tolerance):
 
 from __future__ import annotations
 
-import collections
 import threading
 import time
 from typing import List, Optional, Sequence, Union
@@ -68,7 +67,13 @@ from building_llm_from_scratch_tpu.models.transformer import (
     unstack_blocks,
 )
 from building_llm_from_scratch_tpu.obs.compile import CompileWatcher
-from building_llm_from_scratch_tpu.obs.metrics import get_metrics
+from building_llm_from_scratch_tpu.obs.metrics import (
+    Histogram,
+    RollingRatio,
+    get_metrics,
+    render_prometheus,
+)
+from building_llm_from_scratch_tpu.obs.trace import TICK_PHASES
 from building_llm_from_scratch_tpu.serving.queue import (
     EngineDrainingError,
     QueueFullError,
@@ -82,6 +87,8 @@ from building_llm_from_scratch_tpu.serving.request import (
     FINISH_EXPIRED,
     FINISH_LENGTH,
     FINISH_PREEMPTED,
+    FINISH_REJECTED,
+    FINISH_SHED,
     FINISHED,
     QUEUED,
     REJECTED,
@@ -99,13 +106,6 @@ from building_llm_from_scratch_tpu.serving.supervisor import (
 from building_llm_from_scratch_tpu.utils.logging import setup_logger
 
 logger = setup_logger(__name__)
-
-
-def _percentiles(values: Sequence[float], ps=(50, 95, 99)) -> dict:
-    if not values:
-        return {}
-    arr = np.asarray(values, np.float64)
-    return {f"p{p}": round(float(np.percentile(arr, p)), 6) for p in ps}
 
 
 class DecodeEngine:
@@ -199,10 +199,11 @@ class DecodeEngine:
         self._tpot_ewma: Optional[float] = None
         self._tokens_ewma: Optional[float] = None
 
-        # rolling serve accounting (histogram material for request_done /
-        # serve_summary events and the frontends' reports); bounded so a
-        # long-running deployment holds the most recent window, not every
-        # request ever served
+        # rolling serve accounting: fixed-bucket histograms (obs/metrics
+        # Histogram — Prometheus semantics, O(buckets) memory forever;
+        # replaces the 8192-deque reservoirs whose percentiles silently
+        # covered only the most recent window of a long-running server)
+        # plus a rolling deadline-miss ratio for SLO burn-rate alerting
         self.n_ticks = 0
         self.tokens_generated = 0
         self.requests_finished = 0
@@ -210,12 +211,25 @@ class DecodeEngine:
         self.requests_failed = 0
         self.requests_shed = 0
         self.requests_expired = 0
-        self.ttft_hist = collections.deque(maxlen=self._HIST_MAX)
-        self.tpot_hist = collections.deque(maxlen=self._HIST_MAX)
-        self.queue_wait_hist = collections.deque(maxlen=self._HIST_MAX)
-        self.e2e_hist = collections.deque(maxlen=self._HIST_MAX)
+        self.ttft_hist = Histogram()
+        self.tpot_hist = Histogram()
+        self.queue_wait_hist = Histogram()
+        self.e2e_hist = Histogram()
+        self.slo_window = RollingRatio(window_s=300.0)
+        self._t_start_mono = time.monotonic()
         self._window_tokens = 0
         self._window_t0 = time.monotonic()
+        # per-tick phase breakdown (obs/trace.TICK_PHASES): wall-clock
+        # accumulated with perf_counter ONLY — the instrumentation adds
+        # zero device fetches (guard-tested). `_tick_acc` is the current
+        # metrics window (reset at cadence, logged into the metrics row);
+        # `tick_phase_totals` is cumulative for the /metrics counters.
+        self._tick_acc = {ph: 0.0 for ph in TICK_PHASES}
+        self._tick_acc_total = 0.0
+        self.tick_phase_totals = {ph: 0.0 for ph in TICK_PHASES}
+        self.tick_seconds_total = 0.0
+        self._window_ticks = 0
+        self._win_t0_wall = time.time()
 
     # -- jitted programs (close over params/cfg/blocks so per-tick call
     # signatures carry only the small mutable state + caches) -------------
@@ -333,6 +347,11 @@ class DecodeEngine:
                 f"prompt ({ids.size}) + max_new_tokens "
                 f"({params.max_new_tokens}) = {total} exceeds the "
                 f"engine's slot capacity {self.max_len}")
+        # the Request exists BEFORE any shed/reject decision: every
+        # terminal outcome — even "never entered the queue" — must carry
+        # a request_id on its event and close a span tree under that id,
+        # or trace joins silently drop the requests that were turned away
+        req = Request(next_request_id(), ids, params, on_token=on_token)
         if params.deadline_s is not None:
             # SLO-aware rejection: estimated completion = (queue position
             # / n_slots) x EWMA per-request service time + the request's
@@ -343,25 +362,38 @@ class DecodeEngine:
             if est is not None and est > params.deadline_s:
                 with self._lock:
                     self.requests_shed += 1
+                self.slo_window.observe(miss=True)
                 retry = round(max(self.estimate_queue_clear_s() or 0.0,
                                   0.001), 3)
+                req.error = (f"shed at submit: estimated completion "
+                             f"{est:.2f}s > deadline {params.deadline_s}s")
+                req.finish_reason = FINISH_SHED
+                req.state = REJECTED
+                req.t_finish = time.monotonic()
                 get_metrics().event(
-                    "request_shed", queue_depth=len(self.queue),
+                    "request_shed", request_id=req.id,
+                    reason="slo_predicted_miss",
+                    queue_depth=len(self.queue),
                     deadline_s=params.deadline_s,
                     estimated_e2e_s=round(est, 4), retry_after_s=retry)
+                self._emit_span(req)
+                req._mark_done()
                 raise SLOShedError(
                     f"deadline {params.deadline_s}s unmeetable: estimated "
                     f"completion {est:.2f}s at queue depth "
                     f"{len(self.queue)}", retry_after_s=retry)
-        req = Request(next_request_id(), ids, params, on_token=on_token)
         try:
             self.queue.put(req, block=block, timeout=timeout)
         except QueueFullError:
             req.state = REJECTED
+            req.finish_reason = FINISH_REJECTED
+            req.t_finish = time.monotonic()
             with self._lock:                   # submit() is thread-safe
                 self.requests_rejected += 1
             get_metrics().event("request_rejected", request_id=req.id,
+                                reason="queue_full",
                                 queue_depth=len(self.queue))
+            self._emit_span(req)
             req._mark_done()
             raise
         if self._dead is not None or self._draining:
@@ -447,6 +479,7 @@ class DecodeEngine:
             return True
         if req.expired():
             self.requests_expired += 1
+            self.slo_window.observe(miss=True)
             waited = time.monotonic() - req.t_submit
             req.error = (f"deadline {req.params.deadline_s}s passed after "
                          f"{waited:.2f}s in queue")
@@ -454,9 +487,11 @@ class DecodeEngine:
             req.state = FINISHED
             req.t_finish = time.monotonic()
             get_metrics().event("request_expired", request_id=req.id,
+                                reason="deadline_expired",
                                 deadline_s=req.params.deadline_s,
                                 queue_wait_s=round(waited, 4),
                                 queue_depth=len(self.queue))
+            self._emit_span(req)
             req._mark_done()
             return True
         return False
@@ -488,6 +523,11 @@ class DecodeEngine:
             self._fail_request(slot, req, f"prefill failed: {e!r}",
                                reason="prefill_error")
             return
+        # the `prefill` phase spans dispatch THROUGH the ok-scalar sync:
+        # the jitted call returns before the device finishes (async
+        # dispatch), so timing the call alone would book the execution
+        # wait into whatever host line happens to touch a result first
+        t_pf = time.perf_counter()
         tok, ok, k, v = self._prefill(self.cache["k"], self.cache["v"],
                                       padded, np.int32(Tp), np.int32(slot),
                                       base_key, temp, topk)
@@ -504,7 +544,9 @@ class DecodeEngine:
         self._topks[slot] = topk
         if self.hooks.poison_nan(req):
             self._poison_slot_cache(slot)      # fault injection (tests)
-        if not bool(ok):
+        ok_host = bool(ok)                     # blocks until prefill ran
+        self._tick_add("prefill", time.perf_counter() - t_pf)
+        if not ok_host:
             self._fail_request(slot, req,
                                "non-finite logits in prefill",
                                reason="non_finite_logits")
@@ -527,6 +569,32 @@ class DecodeEngine:
         self.cache = {"k": [nan_row(K) for K in self.cache["k"]],
                       "v": [nan_row(V) for V in self.cache["v"]]}
 
+    # -- tracing / tick accounting ----------------------------------------
+
+    def _emit_span(self, req: Request) -> None:
+        """Write the request's one terminal ``span`` row (request tree:
+        queued/prefill/decode children under a root ``request`` span).
+        Every terminal transition calls this exactly once."""
+        get_metrics().log_span(**req.trace_row())
+
+    def _tick_add(self, phase: str, dt: float) -> None:
+        """Accumulate wall-clock into one tick phase: the current metrics
+        window (drained into the cadence row) and the cumulative totals
+        (the ``/metrics`` counters). perf_counter only — NEVER a device
+        fetch (the no-per-tick-host-sync guard test enforces this)."""
+        self._tick_acc[phase] += dt
+        self.tick_phase_totals[phase] += dt
+
+    def _book_tick_wall(self, t0: float) -> None:
+        """Add a tick's elapsed wall time to the window/cumulative
+        totals. Called on EVERY exit from the timed part of ``step()`` —
+        including generation-abort returns, which have already booked
+        phase seconds: skipping the total there would let a restart
+        window's phases sum past its ``tick_total_s``."""
+        dt = time.perf_counter() - t0
+        self._tick_acc_total += dt
+        self.tick_seconds_total += dt
+
     # -- the tick ---------------------------------------------------------
 
     def step(self) -> bool:
@@ -543,9 +611,18 @@ class DecodeEngine:
         with lock:
             if self._generation != gen or self._dead is not None:
                 return False
+            t_tick0 = time.perf_counter()
             self.hooks.before_tick(self)       # injected hang/fault point
             if self._generation != gen:
+                self._book_tick_wall(t_tick0)
                 return False
+            # tick-phase accounting: `admit` is the admission/cancel/
+            # bookkeeping remainder — the nested prefill device calls and
+            # client callbacks accumulate into their own phases, so they
+            # are subtracted out via before/after snapshots
+            nested0 = (self._tick_acc["prefill"]
+                       + self._tick_acc["callback_detok"])
+            t_adm0 = time.perf_counter()
             # re-run admission until no progress: a request can finish
             # DURING admission (eos on its first sampled token, or
             # max_new_tokens=1), freeing its slot after admit_from already
@@ -557,6 +634,7 @@ class DecodeEngine:
                 for slot, req in admitted:
                     self._admit(slot, req, gen)
                     if self._generation != gen:
+                        self._book_tick_wall(t_tick0)
                         return False
                 if not admitted:
                     break
@@ -568,23 +646,42 @@ class DecodeEngine:
                                        reason="cancelled",
                                        finish=FINISH_CANCELLED)
             active = self.scheduler.active()
+            nested = (self._tick_acc["prefill"]
+                      + self._tick_acc["callback_detok"]) - nested0
+            self._tick_add("admit", max(
+                time.perf_counter() - t_adm0 - nested, 0.0))
             if not active:
-                # all slots free => admission drained the queue too
+                # all slots free => admission drained the queue too (an
+                # admission-only tick — eos/budget hit during prefill —
+                # still books its wall time so phases keep summing to it)
+                self._book_tick_wall(t_tick0)
                 return False
+            t_dec = time.perf_counter()
             nxt, ok, k, v = self._decode(
                 self.cache["k"], self.cache["v"], self._last_tokens,
                 self._lengths, self._base_keys, self._n_gen, self._temps,
                 self._topks)
+            self._tick_add("decode_dispatch", time.perf_counter() - t_dec)
             if self._generation != gen:
+                self._book_tick_wall(t_tick0)
                 return False
+            # `host_fetch` covers the donated-cache rebind AND the two
+            # device->host conversions: dropping the old (donated-away)
+            # cache arrays and np.asarray both block on the in-flight
+            # step, so this phase is "waiting for the device to catch up"
+            t_fetch = time.perf_counter()
             self.cache = {"k": k, "v": v}
             nxt = np.asarray(nxt)
             ok_rows = np.asarray(ok)
+            self._tick_add("host_fetch", time.perf_counter() - t_fetch)
+            cb0 = self._tick_acc["callback_detok"]
+            t_commit = time.perf_counter()
             for slot, req in active:
                 # a slow-client hook inside _accept_token is a wedge point
                 # the supervisor may abandon mid-loop — stop committing
                 # rows the moment the generation moves on
                 if self._generation != gen:
+                    self._book_tick_wall(t_tick0)
                     return False
                 # this tick wrote the slot's previous token at _lengths
                 self._lengths[slot] += 1
@@ -595,7 +692,12 @@ class DecodeEngine:
                         reason="non_finite_logits")
                     continue
                 self._accept_token(slot, req, int(nxt[slot]), gen)
+            self._tick_add("sample_commit", max(
+                time.perf_counter() - t_commit
+                - (self._tick_acc["callback_detok"] - cb0), 0.0))
             self.n_ticks += 1
+            self._window_ticks += 1
+            self._book_tick_wall(t_tick0)
             self._maybe_log_metrics()
             return True
 
@@ -619,6 +721,7 @@ class DecodeEngine:
         self._n_gen[slot] = len(req.output_ids)
         self.tokens_generated += 1
         self._window_tokens += 1
+        t_cb = time.perf_counter()
         try:
             # the request's OWN host path: detok + client callback. A
             # fault here (raising on_token, tokenizer bug on this output)
@@ -629,11 +732,13 @@ class DecodeEngine:
                 req.on_token(req, tok, piece)
             self.hooks.after_token(req, tok)   # injected slow-client point
         except Exception as e:  # noqa: BLE001 — poison request, isolate
+            self._tick_add("callback_detok", time.perf_counter() - t_cb)
             if self._generation != gen:
                 return      # restart already failed this request
             self._fail_request(slot, req, f"token callback failed: {e!r}",
                                reason="callback_error")
             return
+        self._tick_add("callback_detok", time.perf_counter() - t_cb)
         if self._generation != gen:
             # the callback/hook above is a wedge point — un-wedging after
             # a supervisor restart must not finish/free slots that now
@@ -643,10 +748,6 @@ class DecodeEngine:
             req._push_piece(piece)
         if len(req.output_ids) >= req.params.max_new_tokens:
             self._finish(slot, req, FINISH_LENGTH)
-
-    #: per-histogram cap: serve_summary percentiles cover the most recent
-    #: window of finished requests at O(1) memory
-    _HIST_MAX = 8192
 
     #: max tokens a partial multi-byte char may hold back detokenization
     #: before committing anyway (bounds the re-decoded tail per token)
@@ -695,9 +796,16 @@ class DecodeEngine:
         req.state = FINISHED
         req.t_finish = time.monotonic()
         self.requests_failed += 1
+        if req.params.deadline_s is not None and finish != FINISH_CANCELLED:
+            # a failure is an SLO miss — except a client cancellation,
+            # which is the CLIENT giving up; counting it would let
+            # disconnect storms fire the burn-rate alert on a server
+            # that met every deadline it was actually asked to meet
+            self.slo_window.observe(miss=True)
         get_metrics().event("request_failed", request_id=req.id,
                             reason=reason, error=msg, slot=slot,
                             n_tokens=len(req.output_ids))
+        self._emit_span(req)
         logger.warning("Request %d failed (%s): %s", req.id, reason, msg)
         req._mark_done()
         with self._work:
@@ -719,9 +827,15 @@ class DecodeEngine:
                           (self.queue_wait_hist, req.queue_wait_s()),
                           (self.e2e_hist, req.e2e_s())):
             if val is not None:
-                hist.append(val)
+                hist.observe(val)
+        if req.params.deadline_s is not None:
+            # SLO burn-rate: a completion is a miss when it beat the shed
+            # machinery but still finished past its deadline
+            e2e = req.e2e_s() or 0.0
+            self.slo_window.observe(miss=e2e > req.params.deadline_s)
         sink = get_metrics()
         sink.event("request_done", **req.summary())
+        self._emit_span(req)
         sink.gauge("slot_occupancy", self.scheduler.occupancy())
         sink.gauge("queue_depth", len(self.queue))
         req._mark_done()
@@ -732,16 +846,36 @@ class DecodeEngine:
         if self.metrics_every <= 0 or self.n_ticks % self.metrics_every:
             return
         now = time.monotonic()
+        now_wall = time.time()
         dt = max(now - self._window_t0, 1e-9)
         sink = get_metrics()
         sink.gauge("slot_occupancy", self.scheduler.occupancy())
         sink.gauge("queue_depth", len(self.queue))
+        sink.gauge("draining", 1.0 if self._draining else 0.0)
+        slo = self.slo_window.ratio()
+        if slo is not None:
+            sink.gauge("slo_miss_ratio", round(slo, 6))
+        # the window's tick-phase breakdown: wall-clock aggregates only
+        # (perf_counter), fetched device values are NOT involved — the
+        # per-tick host syncs stay exactly the two the decode loop always
+        # had (next-token + ok mask; guard-tested)
+        phases = {f"tick_{ph}_s": round(self._tick_acc[ph], 6)
+                  for ph in TICK_PHASES}
         sink.log_metrics(self.n_ticks,
                          serve_tok_s=round(self._window_tokens / dt, 2),
                          requests_finished=self.requests_finished,
-                         tokens_generated=self.tokens_generated)
+                         tokens_generated=self.tokens_generated,
+                         ticks_in_window=self._window_ticks,
+                         win_t0=round(self._win_t0_wall, 6),
+                         win_dur_s=round(now_wall - self._win_t0_wall, 6),
+                         tick_total_s=round(self._tick_acc_total, 6),
+                         **phases)
         self._window_tokens = 0
         self._window_t0 = now
+        self._window_ticks = 0
+        self._win_t0_wall = now_wall
+        self._tick_acc = {ph: 0.0 for ph in TICK_PHASES}
+        self._tick_acc_total = 0.0
 
     # -- warmup / compile discipline --------------------------------------
 
@@ -772,6 +906,11 @@ class DecodeEngine:
         self._lengths[:] = 0
         self._last_tokens[:] = 0
         self._n_gen[:] = 0
+        # re-anchor the metrics window: the first cadence row should
+        # describe serving, not a window stretched over compile time
+        self._window_t0 = time.monotonic()
+        self._win_t0_wall = time.time()
+        self._window_tokens = 0
         self.warmed_up = True
         get_metrics().event(
             "serve_warmup", n_prefill_buckets=len(buckets),
@@ -864,6 +1003,7 @@ class DecodeEngine:
             self._lock = threading.RLock()
             self._work = threading.Condition()
             failed = 0
+            failed_ids = []
             with self._lock:
                 for slot, req in self.scheduler.active():
                     self._fail_request(
@@ -871,6 +1011,7 @@ class DecodeEngine:
                         f"engine restarted ({reason}): {detail}",
                         reason="engine_restart")
                     failed += 1
+                    failed_ids.append(req.id)
                 self._lengths[:] = 0
                 self._last_tokens[:] = 0
                 self._n_gen[:] = 0
@@ -886,6 +1027,7 @@ class DecodeEngine:
                 "engine_restart", reason=reason, detail=detail,
                 n_restart=n_restart, max_restarts=self.max_restarts,
                 backoff_s=round(backoff, 3), n_inflight_failed=failed,
+                failed_request_ids=failed_ids,
                 queue_depth=len(self.queue))
             logger.error(
                 "Engine restart %d/%d (%s): failed %d in-flight "
@@ -916,23 +1058,37 @@ class DecodeEngine:
                     # queue behind the lock the wedged thread holds
             self._dead = msg
             failed = 0
-            for slot, req in self.scheduler.active():
+            failed_ids = []
+
+            def _kill(req, slot=None):
+                # engine death is still a per-request terminal outcome:
+                # each request gets its own request_failed event + closed
+                # span so trace joins never drop the casualties
                 req.error = msg
                 req.finish_reason = FINISH_ERROR
                 req.state = FINISHED
-                self.scheduler.retire(slot)
+                req.t_finish = time.monotonic()
+                self.requests_failed += 1
+                get_metrics().event("request_failed", request_id=req.id,
+                                    reason="engine_dead", error=msg,
+                                    slot=slot,
+                                    n_tokens=len(req.output_ids))
+                self._emit_span(req)
                 req._mark_done()
+                failed_ids.append(req.id)
+
+            for slot, req in self.scheduler.active():
+                self.scheduler.retire(slot)
+                _kill(req, slot)
                 failed += 1
             while True:
                 req = self.queue.get_nowait()
                 if req is None:
                     break
-                req.error = msg
-                req.finish_reason = FINISH_ERROR
-                req.state = FINISHED
-                req._mark_done()
+                _kill(req)
                 failed += 1
-            get_metrics().event("serve_error", error=msg, n_failed=failed)
+            get_metrics().event("serve_error", error=msg, n_failed=failed,
+                                failed_request_ids=failed_ids)
         finally:
             if locked:
                 lock.release()
@@ -1075,15 +1231,81 @@ class DecodeEngine:
                 "n_restarts": self.n_restarts,
                 "draining": self._draining,
             }
-            hists = [("ttft_s", list(self.ttft_hist)),
-                     ("tpot_s", list(self.tpot_hist)),
-                     ("queue_wait_s", list(self.queue_wait_hist)),
-                     ("e2e_s", list(self.e2e_hist))]
+            slo = self.slo_window.ratio()
+            if slo is not None:
+                out["slo_miss_ratio"] = round(slo, 6)
+            hists = [("ttft_s", self.ttft_hist),
+                     ("tpot_s", self.tpot_hist),
+                     ("queue_wait_s", self.queue_wait_hist),
+                     ("e2e_s", self.e2e_hist)]
         for name, hist in hists:
-            pct = _percentiles(hist)
+            # percentiles are now bucket-interpolated estimates (the
+            # histograms are cumulative and never forget a request)
+            pct = hist.percentiles((50, 95, 99))
             if pct:
                 out[name] = pct
         return out
+
+    def uptime_s(self) -> float:
+        return time.monotonic() - self._t_start_mono
+
+    def metrics_snapshot(self) -> tuple:
+        """(counters, gauges, histograms) for the ``/metrics`` exporter
+        and the structured ``/healthz`` body. TIMED lock acquire: a
+        wedged tick holding the engine lock must not hang the scrape —
+        monitoring an incident is precisely when ``/metrics`` has to
+        answer (the fields are simple attrs, so a lock-less read during
+        a wedge is stale-but-safe)."""
+        lock = self._lock
+        locked = lock.acquire(timeout=0.5)
+        try:
+            counters = {
+                "requests_finished": self.requests_finished,
+                "requests_failed": self.requests_failed,
+                "requests_rejected": self.requests_rejected,
+                "requests_shed": self.requests_shed,
+                "requests_expired": self.requests_expired,
+                "tokens_generated": self.tokens_generated,
+                "engine_restarts": self.n_restarts,
+                "engine_ticks": self.n_ticks,
+                "recompiles": self.n_recompiles,
+                "tick_busy_seconds": round(self.tick_seconds_total, 6),
+            }
+            for ph in TICK_PHASES:
+                counters[f"tick_{ph}_seconds"] = round(
+                    self.tick_phase_totals[ph], 6)
+            gauges = {
+                "slot_occupancy": self.scheduler.occupancy(),
+                "slots_active": self.scheduler.n_active,
+                "slots_total": self.n_slots,
+                "queue_depth": len(self.queue),
+                "queue_capacity": self.queue.max_size,
+                "draining": 1.0 if self._draining else 0.0,
+                "engine_up": 0.0 if self._dead is not None else 1.0,
+                "uptime_seconds": round(self.uptime_s(), 3),
+            }
+            # always exported: a scrape gap (series absent until the
+            # first deadline-carrying request) reads as "no data" on a
+            # dashboard when the truth is "no misses"
+            slo = self.slo_window.ratio()
+            gauges["slo_miss_ratio"] = round(slo, 6) if slo is not None \
+                else 0.0
+            hists = {
+                "ttft_seconds": self.ttft_hist,
+                "tpot_seconds": self.tpot_hist,
+                "queue_wait_seconds": self.queue_wait_hist,
+                "e2e_seconds": self.e2e_hist,
+            }
+        finally:
+            if locked:
+                lock.release()
+        return counters, gauges, hists
+
+    def prometheus_text(self) -> str:
+        """The ``GET /metrics`` body (Prometheus text exposition 0.0.4)."""
+        counters, gauges, hists = self.metrics_snapshot()
+        return render_prometheus(counters, gauges, hists,
+                                 prefix="bllm_serve_")
 
 
 def _prng_key(seed: int):

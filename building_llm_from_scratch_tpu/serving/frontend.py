@@ -12,7 +12,10 @@ Two dependency-free ways to put load on the engine:
     stalls the reader instead of rejecting.
   - HTTP (``--serve_port``): a stdlib ``http.server`` endpoint —
     ``POST /generate`` with the same JSON fields returns the generated
-    text + telemetry; ``GET /healthz`` reports slot/queue/drain state.
+    text + telemetry; ``GET /healthz`` reports a structured stats
+    snapshot (state, uptime, ticks, occupancy, queue, restarts, request
+    counters); ``GET /metrics`` is Prometheus text exposition (latency
+    histograms, occupancy/queue gauges, SLO burn rate) for scraping.
     Status mapping: 429 + Retry-After for queue-full AND SLO shed, 503 +
     Retry-After while draining, 504 for queue-expired deadlines and
     handler timeouts (the timed-out request is CANCELLED, freeing its
@@ -185,6 +188,20 @@ def make_http_server(engine: DecodeEngine, port: int,
             self.wfile.write(body)
 
         def do_GET(self):
+            if self.path == "/metrics":
+                # Prometheus text exposition: counters (requests by
+                # outcome, restarts, per-phase tick seconds), gauges
+                # (occupancy, queue depth, draining, SLO burn rate) and
+                # the TTFT/TPOT/e2e/queue-wait histograms — what the
+                # replica router / alerting scrape
+                body = engine.prometheus_text().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
             if self.path != "/healthz":
                 return self._json(404, {"error": "unknown path"})
             if engine._dead is not None:
@@ -193,7 +210,9 @@ def make_http_server(engine: DecodeEngine, port: int,
                 status = "draining"
             else:
                 status = "serving"
+            counters, gauges, _ = engine.metrics_snapshot()
             self._json(200, {
+                # original fields (kept for compatibility)
                 "status": status,
                 "slots": engine.n_slots,
                 "active": engine.scheduler.n_active,
@@ -202,6 +221,13 @@ def make_http_server(engine: DecodeEngine, port: int,
                 "warmed_up": engine.warmed_up,
                 "draining": engine.draining,
                 "restarts": engine.n_restarts,
+                # structured snapshot (one probe answers "how is it
+                # doing", not just "is it up")
+                "uptime_s": round(engine.uptime_s(), 3),
+                "n_ticks": engine.n_ticks,
+                "occupancy": engine.scheduler.occupancy(),
+                "slo_miss_ratio": gauges.get("slo_miss_ratio"),
+                "counters": counters,
             })
 
         def do_POST(self):
@@ -314,6 +340,7 @@ def run_serve(args, comps, metric_logger) -> DecodeEngine:
         default_deadline_s=(args.serve_deadline_s or None),
         tick_timeout_s=args.serve_tick_timeout,
         max_restarts=args.serve_max_restarts,
+        metrics_every=args.serve_metrics_every,
     )
     stall = None
     if args.stall_timeout > 0 and engine.supervisor is None:
@@ -353,10 +380,23 @@ def run_serve(args, comps, metric_logger) -> DecodeEngine:
     try:
         with stopper:
             watcher.start()
+            http_thread = None
+            if server is not None and args.serve_prompts:
+                # both workloads: HTTP serves CONCURRENTLY with the JSONL
+                # pump — a /metrics scrape or /generate call must not
+                # queue behind the batch (the engine is thread-safe; the
+                # drain path shuts the server down via server.shutdown())
+                http_thread = threading.Thread(
+                    target=serve_http, name="serve-http", daemon=True,
+                    args=(engine, args.serve_port),
+                    kwargs=dict(host=args.serve_host, server=server))
+                http_thread.start()
             if args.serve_prompts:
                 serve_jsonl(engine, args.serve_prompts, args.serve_out,
                             args.serve_max_new_tokens)
-            if server is not None:
+            if http_thread is not None:
+                http_thread.join()      # until SIGTERM/SIGINT stops it
+            elif server is not None:
                 serve_http(engine, args.serve_port, host=args.serve_host,
                            server=server)
     finally:

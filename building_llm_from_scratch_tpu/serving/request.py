@@ -28,6 +28,8 @@ FINISH_ERROR = "error"   # engine failure (req.error holds the message)
 FINISH_EXPIRED = "expired"      # deadline passed while queued (shed)
 FINISH_PREEMPTED = "preempted"  # drain timeout hit before it finished
 FINISH_CANCELLED = "cancelled"  # client gave up (timeout/disconnect)
+FINISH_SHED = "shed"            # SLO-rejected at submit (predicted miss)
+FINISH_REJECTED = "rejected"    # bounded queue at capacity at submit
 
 
 class RequestExpiredError(RuntimeError):
@@ -85,8 +87,12 @@ class Request:
         self.error: Optional[str] = None
         self._cancelled = False  # client gave up; retired at next boundary
         # timestamps (time.monotonic): submit -> admit (queue wait) ->
-        # first token (TTFT) -> finish (TPOT over the decode tail)
+        # first token (TTFT) -> finish (TPOT over the decode tail).
+        # wall_submit anchors the monotonic timeline to unix time so the
+        # request's trace spans land on the same clock as every other
+        # JSONL row (obs/trace.py joins them into one timeline)
         self.t_submit = time.monotonic()
+        self.wall_submit = time.time()
         self.t_deadline: Optional[float] = (
             self.t_submit + params.deadline_s
             if params.deadline_s is not None else None)
@@ -184,6 +190,58 @@ class Request:
                 out[name] = round(v, 6)
         return out
 
+    # -- tracing ----------------------------------------------------------
+
+    def _wall(self, t_mono: Optional[float]) -> Optional[float]:
+        """Monotonic timestamp -> unix wall time via the submit anchor."""
+        if t_mono is None:
+            return None
+        return self.wall_submit + (t_mono - self.t_submit)
+
+    def outcome(self) -> str:
+        """Terminal label for the span row: the finish reason, or the
+        state for requests that never got one (rejected at submit)."""
+        return self.finish_reason or self.state
+
+    def trace_row(self) -> dict:
+        """The request's ``span`` row (obs/metrics.log_span kwargs): one
+        root ``request`` span [submit, terminal] with ``queued`` /
+        ``prefill`` / ``decode`` children for every phase the request
+        actually reached. Emitted ONCE, at the terminal transition — so
+        a trace join on ``request_id`` sees exactly one closed tree per
+        request, whatever its outcome."""
+        t_end = self.t_finish if self.t_finish is not None else (
+            time.monotonic())
+        children = [{"name": "queued", "t0": self.wall_submit,
+                     "dur_s": (self.t_admit if self.t_admit is not None
+                               else t_end) - self.t_submit}]
+        if self.t_admit is not None:
+            t_ft = (self.t_first_token if self.t_first_token is not None
+                    else min(t_end, self.t_admit))
+            children.append({"name": "prefill",
+                             "t0": self._wall(self.t_admit),
+                             "dur_s": max(t_ft - self.t_admit, 0.0)})
+            if self.t_first_token is not None:
+                children.append({"name": "decode",
+                                 "t0": self._wall(self.t_first_token),
+                                 "dur_s": max(t_end - self.t_first_token,
+                                              0.0)})
+        row = {
+            "name": "request", "cat": "request",
+            "t0": self.wall_submit,
+            "dur_s": max(t_end - self.t_submit, 0.0),
+            "children": children,
+            "request_id": self.id,
+            "outcome": self.outcome(),
+            "n_prompt_tokens": int(len(self.prompt_ids)),
+            "n_tokens": len(self.output_ids),
+        }
+        if self.slot is not None:
+            row["slot"] = self.slot
+        if self.error is not None:
+            row["error"] = self.error
+        return row
+
     # -- engine internals -------------------------------------------------
 
     def _push_piece(self, piece: str) -> None:
@@ -216,6 +274,7 @@ __all__: List[Any] = [
     "QUEUED", "RUNNING", "FINISHED", "REJECTED",
     "FINISH_EOS", "FINISH_LENGTH", "FINISH_ERROR",
     "FINISH_EXPIRED", "FINISH_PREEMPTED", "FINISH_CANCELLED",
+    "FINISH_SHED", "FINISH_REJECTED",
     "RequestExpiredError",
     "SamplingParams", "Request", "resolve_eos", "next_request_id",
 ]

@@ -91,6 +91,7 @@ engine = main(get_args([
     "--data_dir", d,                      # unused in serve mode
     "--serve_prompts", reqs, "--serve_out", out,
     "--serve_slots", "4", "--serve_max_queue", "8",
+    "--serve_metrics_every", "4",         # tick-breakdown cadence rows
     "--metrics_jsonl", mj,
 ]))
 results = [json.loads(l) for l in open(out)]
@@ -99,21 +100,36 @@ assert all(r["finish_reason"] in ("eos", "length") for r in results), results
 rows = [json.loads(l) for l in open(mj)]
 done = [r for r in rows if r.get("event") == "request_done"]
 assert len(done) >= 1, "no request_done event in the JSONL"
+spans = [r for r in rows if r.get("type") == "span"]
+assert len(spans) == 8, f"expected one span tree per request: {len(spans)}"
 recompiles = [r for r in rows if r.get("event") == "recompile"]
 assert not recompiles, f"recompile after warmup: {recompiles}"
 assert engine.n_recompiles == 0
+# trace exporter round-trip on the smoke's JSONL: Perfetto-loadable
+# Chrome trace with per-request span trees AND tick windows
+from building_llm_from_scratch_tpu.obs.trace import export_chrome_trace
+trace_path = os.path.join(d, "trace.json")
+meta = export_chrome_trace(mj, trace_path)
+assert meta["n_request_spans"] == 8, meta
+assert meta["n_tick_windows"] >= 1, meta
+json.load(open(trace_path))               # valid JSON
 print(f"serving smoke ok: {len(results)} requests, "
       f"{sum(r['n_tokens'] for r in results)} tokens, "
-      f"{len(done)} request_done events, 0 recompiles")
+      f"{len(done)} request_done events, 0 recompiles, "
+      f"{meta['n_request_spans']} trace spans, "
+      f"{meta['n_tick_windows']} tick windows")
 EOF
 
-echo "== serving drain smoke (SIGTERM mid-serve, CPU) =="
+echo "== serving drain smoke (SIGTERM + mid-run /metrics scrape, CPU) =="
 JAX_PLATFORMS=cpu python - <<'EOF' || exit 1
-import json, os, signal, subprocess, sys, tempfile, time
+import json, os, signal, socket, subprocess, sys, tempfile, time
+import urllib.request
 d = tempfile.mkdtemp()
 # 8 requests on 2 slots: when the SIGTERM lands after the first result
 # line, most of the batch is still in flight/queued — the drain must
-# finish ALL of it (generous --drain_timeout) and exit 0
+# finish ALL of it (generous --drain_timeout) and exit 0. The HTTP
+# endpoint rides along so /metrics can be scraped MID-RUN (the server
+# thread serves concurrently with the JSONL pump).
 reqs = os.path.join(d, "requests.jsonl")
 with open(reqs, "w") as f:
     for i in range(8):
@@ -122,20 +138,31 @@ with open(reqs, "w") as f:
                             "ignore_eos": True, "seed": i}) + "\n")
 out = os.path.join(d, "results.jsonl")
 mj = os.path.join(d, "metrics.jsonl")
+s = socket.socket(); s.bind(("127.0.0.1", 0))
+port = s.getsockname()[1]; s.close()
 proc = subprocess.Popen(
     [sys.executable, "-m", "building_llm_from_scratch_tpu",
      "--mode", "serve", "--debug", "--byte_tokenizer", "--data_dir", d,
      "--serve_prompts", reqs, "--serve_out", out,
      "--serve_slots", "2", "--serve_max_queue", "8",
+     "--serve_port", str(port), "--serve_metrics_every", "4",
      "--drain_timeout", "120", "--metrics_jsonl", mj],
     stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
     env=dict(os.environ, JAX_PLATFORMS="cpu"))
 deadline = time.monotonic() + 300
 signaled = False
+scraped = None
 while time.monotonic() < deadline:
     if proc.poll() is not None:
         break                      # finished before we could preempt it
     if os.path.exists(out) and open(out).read().count("\n") >= 1:
+        try:
+            # mid-run scrape: >=1 request finished, most still in flight
+            scraped = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=60
+            ).read().decode()
+        except OSError as e:
+            print(f"note: mid-run /metrics scrape failed ({e})")
         proc.send_signal(signal.SIGTERM)   # preempt mid-serve
         signaled = True
         break
@@ -157,6 +184,21 @@ else:
     # still hold; skip only the signal-dependent ones
     print("note: serve finished before SIGTERM could land; "
           "drain-event asserts skipped this run")
+if scraped is not None:
+    # exposition parses: every sample line is "name[{labels}] value"
+    samples = {}
+    for line in scraped.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name, value = line.rsplit(" ", 1)
+        samples[name] = float(value)
+    ttft = samples.get("bllm_serve_ttft_seconds_count", 0)
+    assert ttft >= 1, f"ttft histogram empty mid-run: {ttft}"
+    assert "bllm_serve_slot_occupancy" in samples, sorted(samples)[:20]
+    assert samples.get("bllm_serve_engine_up") == 1.0
+    print(f"mid-run /metrics scrape ok: {len(samples)} samples, "
+          f"ttft_count={ttft:g}, "
+          f"occupancy={samples['bllm_serve_slot_occupancy']:g}")
 recompiles = [r for r in rows if r.get("event") == "recompile"]
 assert not recompiles, f"recompile during drained serve: {recompiles}"
 print(f"drain smoke ok (signaled={signaled}): {len(results)} results all "

@@ -8,9 +8,11 @@ artifact, this is just one renderer over it.
 Prints the run header, per-event-kind counts, final/peak numbers, the
 per-layer-group grad-norm trajectory (``health`` rows), the compile
 telemetry (compile seconds, HLO FLOPs, HLO-vs-analytic MFU delta,
-recompiles), the serving section (per-request latency percentiles, slot
-occupancy, queue depth — ``--mode serve`` runs) and the HBM budget
-breakdown to stdout; writes a 2x2 figure
+recompiles), the serving section (per-request latency percentiles, the
+engine tick-phase breakdown + SLO burn, slot occupancy, queue depth —
+``--mode serve`` runs) and the HBM budget breakdown to stdout;
+``--trace out.json`` additionally exports the run as Perfetto-loadable
+Chrome trace JSON (obs/trace.py); writes a 2x2 figure
 (train/val loss, tok/s, MFU, memory) when matplotlib is available (text
 summary still works without it).
 """
@@ -19,6 +21,17 @@ import argparse
 import json
 import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+try:
+    # canonical phase list (obs/trace.py — what the engine actually logs);
+    # the renderer itself stays dependency-free, so a missing/broken
+    # package install falls back to a pinned copy instead of crashing
+    from building_llm_from_scratch_tpu.obs.trace import TICK_PHASES
+except Exception:                                      # pragma: no cover
+    TICK_PHASES = ("admit", "prefill", "decode_dispatch", "host_fetch",
+                   "sample_commit", "callback_detok")
 
 
 def load_rows(path):
@@ -177,6 +190,7 @@ def summarize_serving(metrics, events):
             print(f"  {label:<12} p50 {1e3 * _pctile(vals, 50):8.2f} ms   "
                   f"p95 {1e3 * _pctile(vals, 95):8.2f} ms   "
                   f"p99 {1e3 * _pctile(vals, 99):8.2f} ms")
+    summarize_ticks(metrics, events)
     occ = [r["slot_occupancy"] for r in metrics
            if isinstance(r.get("slot_occupancy"), (int, float))]
     if occ:
@@ -196,6 +210,57 @@ def summarize_serving(metrics, events):
         print(f"  !! {summaries[-1]['n_recompiles']} RECOMPILES after "
               "warmup — prompt lengths outside the warmed bucket set "
               "(see the recompile events' leaf diffs)")
+
+
+def summarize_ticks(metrics, events):
+    """Tick-breakdown + SLO-burn section: per-tick p50/p95 for each engine
+    phase (admit/prefill/decode_dispatch/host_fetch/sample_commit/
+    callback_detok), the prefill-vs-decode share of tick time (prefill
+    head-of-line blocking shows up HERE as a fat prefill share), and the
+    run's deadline-miss ratio."""
+    rows = [r for r in metrics
+            if isinstance(r.get("tick_total_s"), (int, float))
+            and isinstance(r.get("ticks_in_window"), (int, float))
+            and r["ticks_in_window"] > 0]
+    if rows:
+        print("  tick breakdown (per-tick, over "
+              f"{int(sum(r['ticks_in_window'] for r in rows))} ticks):")
+        sums = {}
+        for ph in TICK_PHASES:
+            per_tick = [r[f"tick_{ph}_s"] / r["ticks_in_window"]
+                        for r in rows
+                        if isinstance(r.get(f"tick_{ph}_s"), (int, float))]
+            sums[ph] = sum(r.get(f"tick_{ph}_s", 0) for r in rows
+                           if isinstance(r.get(f"tick_{ph}_s"),
+                                         (int, float)))
+            if per_tick:
+                print(f"    {ph:<16} p50 {1e3 * _pctile(per_tick, 50):8.3f}"
+                      f" ms   p95 {1e3 * _pctile(per_tick, 95):8.3f} ms")
+        total = sum(r["tick_total_s"] for r in rows)
+        if total > 0:
+            pf, dec = sums.get("prefill", 0), sums.get("decode_dispatch", 0)
+            line = (f"    prefill {100 * pf / total:.1f}% vs decode "
+                    f"{100 * dec / total:.1f}% of tick time")
+            if pf > dec:
+                line += (" — PREFILL-DOMINATED: long prompts are blocking "
+                         "decode ticks (head-of-line); consider chunked "
+                         "prefill / smaller prompt buckets")
+            print(line)
+    # deadline-miss (SLO burn) over the whole run, from request events:
+    # done-with-deadline (miss when e2e blew it) + expired + shed
+    done = [e for e in events if e["event"] == "request_done"
+            and isinstance(e.get("deadline_s"), (int, float))]
+    late = [e for e in done
+            if isinstance(e.get("e2e_s"), (int, float))
+            and e["e2e_s"] > e["deadline_s"]]
+    shed = [e for e in events if e["event"] == "request_shed"]
+    expired = [e for e in events if e["event"] == "request_expired"]
+    n_slo = len(done) + len(shed) + len(expired)
+    if n_slo:
+        misses = len(late) + len(shed) + len(expired)
+        print(f"  SLO burn: {misses}/{n_slo} deadline-carrying requests "
+              f"missed ({100 * misses / n_slo:.1f}%: {len(late)} finished "
+              f"late, {len(shed)} shed, {len(expired)} expired)")
 
 
 def summarize_serving_resilience(failed, shed, expired, events):
@@ -407,12 +472,27 @@ def main(argv=None):
     p.add_argument("jsonl", help="metrics JSONL written by --metrics_jsonl")
     p.add_argument("--out", default=None,
                    help="figure path (default: <jsonl dir>/metrics.png)")
+    p.add_argument("--trace", default=None, metavar="TRACE_JSON",
+                   help="also export the run as Chrome trace-event JSON "
+                        "(request span trees, engine tick windows, train "
+                        "step windows, incidents) — load it at "
+                        "https://ui.perfetto.dev")
     args = p.parse_args(argv)
     header, metrics, events, health = load_rows(args.jsonl)
     summarize(header, metrics, events)
     summarize_compile(metrics, events)
     summarize_serving(metrics, events)
     summarize_health(health)
+    if args.trace:
+        from building_llm_from_scratch_tpu.obs.trace import (
+            export_chrome_trace,
+        )
+
+        meta = export_chrome_trace(args.jsonl, args.trace)
+        print(f"trace written to {args.trace} "
+              f"({meta['n_request_spans']} request spans, "
+              f"{meta['n_tick_windows']} tick windows, "
+              f"{meta['n_train_windows']} train windows)")
     if metrics:
         out = args.out or os.path.join(
             os.path.dirname(os.path.abspath(args.jsonl)), "metrics.png")

@@ -1,0 +1,454 @@
+"""Serving observability tests: request-span tracing + Chrome trace
+export (obs/trace.py), the per-tick engine phase breakdown, the
+Prometheus ``/metrics`` endpoint and structured ``/healthz``, the
+histogram/rolling-window aggregation primitives, and the serving
+extension of the no-per-step-host-sync guard (instrumentation must add
+ZERO device fetches to the decode tick).
+"""
+
+import http.client
+import json
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from building_llm_from_scratch_tpu.configs import ModelConfig
+from building_llm_from_scratch_tpu.models import init_params
+from building_llm_from_scratch_tpu.obs import (
+    Histogram,
+    RollingRatio,
+    chrome_trace,
+    configure_metrics,
+    export_chrome_trace,
+    render_prometheus,
+)
+from building_llm_from_scratch_tpu.obs.trace import TICK_PHASES
+from building_llm_from_scratch_tpu.serving import (
+    DecodeEngine,
+    QueueFullError,
+    SamplingParams,
+    SLOShedError,
+)
+
+
+def tiny_cfg(ctx=64, **kw):
+    base = dict(name="trace-tiny", vocab_size=96, context_length=ctx,
+                emb_dim=32, n_heads=2, n_layers=2, hidden_dim=64,
+                n_kv_groups=2, norm="layernorm", positional="learned",
+                activation="gelu", drop_rate=0.0, eos_id=1)
+    base.update(kw)
+    return ModelConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_cfg()
+    return cfg, init_params(cfg, jax.random.PRNGKey(0))
+
+
+@pytest.fixture
+def sink(tmp_path):
+    """A fresh JSONL metrics sink for one test; always detached after."""
+    path = tmp_path / "metrics.jsonl"
+    logger = configure_metrics(str(path), run_metadata={"test": True})
+    yield str(path)
+    logger.close()
+    configure_metrics(None)
+
+
+def load_rows(path):
+    return [json.loads(line) for line in open(path)]
+
+
+# ---------------------------------------------------------------------------
+# aggregation primitives (no jax)
+# ---------------------------------------------------------------------------
+
+def test_histogram_bucket_counts_match_observations():
+    h = Histogram(bounds=(0.01, 0.1, 1.0))
+    values = [0.005, 0.005, 0.05, 0.5, 5.0]        # 2 / 1 / 1 / 1(+Inf)
+    for v in values:
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap["count"] == len(values)
+    assert snap["sum"] == pytest.approx(sum(values))
+    assert snap["buckets"] == [(0.01, 2), (0.1, 3), (1.0, 4), ("+Inf", 5)]
+    # upper-edge inclusivity (prometheus `le` semantics)
+    h2 = Histogram(bounds=(1.0, 2.0))
+    h2.observe(1.0)
+    assert h2.snapshot()["buckets"][0] == (1.0, 1)
+    # percentile interpolates inside the target bucket; +Inf clamps
+    assert 0.0 < h.percentile(10) <= 0.01
+    assert h.percentile(99) == 1.0                  # clamped to last bound
+    assert Histogram().percentile(50) is None       # empty
+
+
+def test_rolling_ratio_window_expires_old_misses():
+    r = RollingRatio(window_s=10.0, n_buckets=5)
+    t0 = 1000.0
+    r.observe(True, now=t0)
+    r.observe(True, now=t0)
+    r.observe(False, now=t0 + 1)
+    assert r.ratio(now=t0 + 1) == pytest.approx(2 / 3)
+    # 11s later the misses have aged out; only fresh observations count
+    r.observe(False, now=t0 + 12)
+    assert r.ratio(now=t0 + 12) == 0.0
+    assert RollingRatio().ratio() is None           # nothing observed
+
+
+def test_render_prometheus_exposition_format():
+    h = Histogram(bounds=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(5.0)
+    text = render_prometheus({"done": 3}, {"occupancy": 0.5},
+                             {"ttft_seconds": h}, prefix="x_")
+    lines = text.splitlines()
+    assert "x_done_total 3" in lines
+    assert "x_occupancy 0.5" in lines
+    assert 'x_ttft_seconds_bucket{le="0.1"} 1' in lines
+    assert 'x_ttft_seconds_bucket{le="+Inf"} 2' in lines
+    assert "x_ttft_seconds_count 2" in lines
+    # every non-comment line is "name{labels} value" with a float value
+    for line in lines:
+        if line.startswith("#") or not line:
+            continue
+        name, value = line.rsplit(" ", 1)
+        float(value)
+        assert name[0].isalpha()
+
+
+# ---------------------------------------------------------------------------
+# request span trees + Chrome trace export
+# ---------------------------------------------------------------------------
+
+def test_request_spans_and_chrome_export_round_trip(model, sink, tmp_path):
+    cfg, params = model
+    eng = DecodeEngine(cfg, params, n_slots=2, max_len=64, metrics_every=2)
+    eng.warmup()
+    handles = [eng.submit(np.array([3, 4, 5], np.int32),
+                          SamplingParams(max_new_tokens=5, ignore_eos=True,
+                                         seed=i))
+               for i in range(3)]
+    eng.run_until_idle()
+    for h in handles:
+        h.result(timeout=10)
+    eng.shutdown()
+    rows = load_rows(sink)
+    spans = [r for r in rows if r.get("type") == "span"]
+    done = [r for r in rows if r.get("event") == "request_done"]
+    # exactly one span row per completed request
+    assert len(spans) == len(done) == 3
+    for s in spans:
+        assert s["name"] == "request" and s["outcome"] == "length"
+        kids = {c["name"]: c for c in s["children"]}
+        assert set(kids) == {"queued", "prefill", "decode"}
+        # children nest inside the root span and all spans are closed
+        t0, t1 = s["t0"], s["t0"] + s["dur_s"]
+        for c in s["children"]:
+            assert c["dur_s"] >= 0
+            assert c["t0"] >= t0 - 1e-6
+            assert c["t0"] + c["dur_s"] <= t1 + 1e-6
+        # phases tile the root span in lifecycle order
+        assert kids["queued"]["t0"] <= kids["prefill"]["t0"]
+        assert kids["prefill"]["t0"] <= kids["decode"]["t0"]
+
+    out = tmp_path / "trace.json"
+    meta = export_chrome_trace(sink, str(out))
+    assert meta["n_request_spans"] == 3
+    assert meta["n_tick_windows"] >= 1
+    trace = json.load(open(out))                   # valid JSON round-trip
+    events = trace["traceEvents"]
+    assert events
+    xs = [e for e in events if e["ph"] == "X"]
+    for e in events:
+        assert e["ph"] in ("X", "i", "C", "M")
+        if e["ph"] == "X":
+            assert e["dur"] >= 0 and e["ts"] >= 0
+    # one root request slice per request_done, on that request's track
+    roots = [e for e in xs if e["name"] == "request"]
+    assert len(roots) == 3
+    assert len({e["tid"] for e in roots}) == 3
+    # tick windows made it out too
+    assert any(e["name"].startswith("ticks") for e in xs)
+
+
+def test_trace_lifecycle_audit_every_outcome_closes_one_tree(model, sink):
+    """Satellite: submit one request per terminal outcome (done, rejected,
+    shed, expired, failed) and assert the trace joins never drop one —
+    every lifecycle event carries request_id (and reason), and every id
+    closes exactly one span tree."""
+    cfg, params = model
+    eng = DecodeEngine(cfg, params, n_slots=1, max_len=64, max_queue=1,
+                       metrics_every=0)
+    eng.warmup()
+
+    # DONE
+    done_h = eng.submit(np.array([3, 4], np.int32),
+                        SamplingParams(max_new_tokens=3, ignore_eos=True))
+    eng.run_until_idle()
+    done_h.result(timeout=10)
+
+    # FAILED: a raising client callback is the request's own fault
+    def bad_cb(req, tok, piece):
+        raise RuntimeError("client exploded")
+
+    failed_h = eng.submit(np.array([5], np.int32),
+                          SamplingParams(max_new_tokens=3, ignore_eos=True),
+                          on_token=bad_cb)
+    eng.run_until_idle()
+    with pytest.raises(RuntimeError):
+        failed_h.result(timeout=10)
+
+    # REJECTED: queue capacity 1, nothing ticking
+    held = eng.submit(np.array([6], np.int32),
+                      SamplingParams(max_new_tokens=2, ignore_eos=True))
+    with pytest.raises(QueueFullError):
+        eng.submit(np.array([7], np.int32),
+                   SamplingParams(max_new_tokens=2, ignore_eos=True))
+
+    # EXPIRED: deadline passes while queued
+    eng.run_until_idle()                            # finishes `held`
+    held.result(timeout=10)
+    expired_h = eng.submit(np.array([8], np.int32),
+                           SamplingParams(max_new_tokens=2,
+                                          ignore_eos=True,
+                                          deadline_s=0.01))
+    time.sleep(0.05)                                # deadline passes
+    eng.run_until_idle()
+    from building_llm_from_scratch_tpu.serving.request import (
+        RequestExpiredError,
+    )
+
+    with pytest.raises(RequestExpiredError):
+        expired_h.result(timeout=10)
+
+    # SHED: service EWMAs exist now; an impossible deadline is rejected
+    # at submit (predicted miss), without ever entering the queue
+    with pytest.raises(SLOShedError):
+        eng.submit(np.array([9], np.int32),
+                   SamplingParams(max_new_tokens=60, ignore_eos=True,
+                                  deadline_s=1e-6))
+    eng.shutdown()
+
+    rows = load_rows(sink)
+    events = [r for r in rows if r.get("type") == "event"]
+    spans = [r for r in rows if r.get("type") == "span"]
+    by_kind = {}
+    for e in events:
+        by_kind.setdefault(e["event"], []).append(e)
+    # every lifecycle event names its request and its reason
+    for kind in ("request_rejected", "request_shed", "request_expired",
+                 "request_failed"):
+        assert by_kind.get(kind), f"missing {kind} event"
+        for e in by_kind[kind]:
+            assert isinstance(e.get("request_id"), int), (kind, e)
+            assert e.get("reason"), (kind, e)
+    # exactly ONE closed span tree per request id, outcome attached
+    by_id = {}
+    for s in spans:
+        by_id.setdefault(s["request_id"], []).append(s)
+    assert all(len(v) == 1 for v in by_id.values()), by_id
+    outcomes = {s["request_id"]: s["outcome"] for s in spans}
+    expected = {"length", "error", "rejected", "shed", "expired"}
+    assert expected <= set(outcomes.values()), outcomes
+    for s in spans:
+        assert s["dur_s"] >= 0 and s["children"], s
+        assert s["children"][0]["name"] == "queued"
+    # ... and the trace join sees them all (5 requests -> 5 trees:
+    # done, failed, held/done, rejected, expired, shed = 6 actually)
+    trace = chrome_trace(rows)
+    assert trace["metadata"]["n_request_spans"] == len(spans) == 6
+
+
+def test_trace_export_handles_training_fixture(tmp_path):
+    """The exporter renders TRAINING runs too: the checked-in fixture's
+    StepTimeline cadence rows become train windows and its compile events
+    become slices — one exporter for both tiers."""
+    out = tmp_path / "train_trace.json"
+    meta = export_chrome_trace("tests/fixtures/metrics_fixture.jsonl",
+                               str(out))
+    assert meta["n_train_windows"] >= 1
+    trace = json.load(open(out))
+    xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    assert any(e["name"].startswith("steps") for e in xs)
+    assert any(e["name"].startswith("compile:") for e in xs)
+    assert any(e["cat"] == "steps_phase" for e in xs)
+    # incidents (watchdog_halt in the fixture) land as instants
+    instants = [e for e in trace["traceEvents"] if e["ph"] == "i"]
+    assert any(e["name"] == "watchdog_halt" for e in instants)
+
+
+# ---------------------------------------------------------------------------
+# per-tick engine phase breakdown
+# ---------------------------------------------------------------------------
+
+def test_tick_phase_breakdown_sums_to_tick_wall_time(model, sink):
+    cfg, params = model
+    eng = DecodeEngine(cfg, params, n_slots=2, max_len=64, metrics_every=4)
+    eng.warmup()
+    handles = [eng.submit(np.array([3, 4, 5, 6], np.int32),
+                          SamplingParams(max_new_tokens=12,
+                                         ignore_eos=True, seed=i))
+               for i in range(4)]
+    eng.run_until_idle()
+    for h in handles:
+        h.result(timeout=10)
+    eng.shutdown()
+    rows = load_rows(sink)
+    ticks = [r for r in rows if r.get("type") == "metrics"
+             and isinstance(r.get("tick_total_s"), (int, float))
+             and r.get("ticks_in_window")]
+    assert ticks, "no serving cadence rows with a tick breakdown"
+    for r in ticks:
+        phase_sum = sum(r[f"tick_{ph}_s"] for ph in TICK_PHASES)
+        total = r["tick_total_s"]
+        # phases are measured sub-intervals of the tick: their sum can
+        # never exceed the tick wall time, and the unattributed remainder
+        # (branching, scheduler bookkeeping) must stay small
+        assert phase_sum <= total * 1.02 + 1e-6, r
+        assert phase_sum >= total * 0.5, r
+        assert r["win_dur_s"] > 0 and r["win_t0"] > 0
+    # cumulative totals cover the whole run for /metrics counters
+    assert eng.tick_seconds_total > 0
+    assert sum(eng.tick_phase_totals.values()) <= eng.tick_seconds_total * 1.02
+    # decode must be a real, nonzero phase on every loaded window
+    assert all(r["tick_decode_dispatch_s"] > 0 for r in ticks)
+
+
+def test_tick_instrumentation_adds_no_device_fetches(model):
+    """Serving extension of the PR-3 no-per-step-host-sync guard: the
+    decode tick fetches exactly TWO device values per tick (next-token
+    row + finite-ok mask) — the tick-timeline instrumentation and the
+    cadence metrics flush must add zero additional fetches, and the KV
+    cache must never round-trip through the host."""
+    cfg, params = model
+    eng = DecodeEngine(cfg, params, n_slots=2, max_len=64, metrics_every=2,
+                       watch_compiles=False)
+    eng.warmup()
+
+    fetches = {"nxt": 0, "ok": 0}
+
+    class Guarded:
+        def __init__(self, val, key):
+            self._val = val
+            self._key = key
+
+        def __array__(self, dtype=None, copy=None):
+            fetches[self._key] += 1
+            out = np.asarray(self._val)
+            return out.astype(dtype) if dtype is not None else out
+
+    real_decode = eng._decode
+
+    def spy(*args):
+        nxt, ok, k, v = real_decode(*args)
+        return Guarded(nxt, "nxt"), Guarded(ok, "ok"), k, v
+
+    eng._decode = spy
+    handles = [eng.submit(np.array([3, 4], np.int32),
+                          SamplingParams(max_new_tokens=8, ignore_eos=True,
+                                         seed=i))
+               for i in range(3)]
+    eng.run_until_idle()
+    for h in handles:
+        h.result(timeout=10)
+    n_decode_ticks = eng.n_ticks
+    assert n_decode_ticks >= 8
+    # exactly one conversion of each output per tick — cadence flushes
+    # (metrics_every=2 fired several times) added none
+    assert fetches["nxt"] == n_decode_ticks, fetches
+    assert fetches["ok"] == n_decode_ticks, fetches
+    # the KV cache stayed on device end to end
+    import jax as _jax
+
+    for pane in ("k", "v"):
+        for layer in eng.cache[pane]:
+            assert isinstance(layer, _jax.Array), type(layer)
+    eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# /metrics + structured /healthz over HTTP
+# ---------------------------------------------------------------------------
+
+def _parse_exposition(text):
+    """Tiny Prometheus text-format parser: {series_name: [(labels, value)]}
+    — raises on any malformed line, which IS the format assertion."""
+    series = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name_part, value = line.rsplit(" ", 1)
+        if "{" in name_part:
+            name, labels = name_part.split("{", 1)
+            assert labels.endswith("}")
+            labels = labels[:-1]
+        else:
+            name, labels = name_part, ""
+        series.setdefault(name, []).append((labels, float(value)))
+    return series
+
+
+def test_metrics_endpoint_exposition_and_structured_healthz(model):
+    cfg, params = model
+    from building_llm_from_scratch_tpu.serving.frontend import (
+        make_http_server,
+    )
+
+    eng = DecodeEngine(cfg, params, n_slots=2, max_len=64)
+    eng.warmup()
+    eng.start()
+    server = make_http_server(eng, 0, host="127.0.0.1")
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        for i in range(3):
+            body = json.dumps({"prompt_ids": [5, 6, 7],
+                               "max_new_tokens": 4, "ignore_eos": True,
+                               "seed": i, "deadline_s": 60.0})
+            conn.request("POST", "/generate", body=body)
+            assert conn.getresponse().status == 200
+
+        conn.request("GET", "/metrics")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.getheader("Content-Type").startswith("text/plain")
+        series = _parse_exposition(resp.read().decode())
+
+        pre = "bllm_serve_"
+        assert series[pre + "requests_finished_total"][0][1] == 3
+        # histogram bucket counts match the number of finished requests
+        for h in ("ttft_seconds", "e2e_seconds", "queue_wait_seconds"):
+            buckets = dict(series[pre + h + "_bucket"])
+            assert buckets['le="+Inf"'] == 3, (h, buckets)
+            assert series[pre + h + "_count"][0][1] == 3
+            # cumulative and monotone in `le`
+            counts = [v for _, v in series[pre + h + "_bucket"]]
+            assert counts == sorted(counts)
+        # key gauges for the replica router
+        assert pre + "slot_occupancy" in series
+        assert pre + "queue_depth" in series
+        assert pre + "engine_up" in series
+        assert series[pre + "uptime_seconds"][0][1] > 0
+        # deadline-carrying requests all finished in time -> burn 0.0
+        assert series[pre + "slo_miss_ratio"][0][1] == 0.0
+        # per-phase tick time is exported as counters
+        assert pre + "tick_decode_dispatch_seconds_total" in series
+
+        conn.request("GET", "/healthz")
+        health = json.loads(conn.getresponse().read())
+        assert health["status"] == "serving"
+        assert health["slots"] == 2                 # compat fields intact
+        assert health["uptime_s"] > 0
+        assert health["n_ticks"] >= 1
+        assert 0.0 <= health["occupancy"] <= 1.0
+        assert health["counters"]["requests_finished"] == 3
+        conn.close()
+    finally:
+        server.shutdown()
+        server.server_close()
+        eng.shutdown()
