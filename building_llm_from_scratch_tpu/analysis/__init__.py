@@ -1,0 +1,34 @@
+"""graft-lint: static invariant analysis + runtime sanitizers.
+
+The repo's hardest-won properties — zero implicit host syncs in steady
+state, ONE compiled program per workload, lock-safe threaded serving, a
+drift-free telemetry schema — were enforced by guard tests and reviewer
+vigilance. This package checks them by machine on every CI run:
+
+  - ``hostsync``   — GL01x: implicit device->host transfers in
+    registered hot paths (the step loop, the decode tick, the
+    prefetcher);
+  - ``jitpurity``  — GL02x: trace-impurity and recompile hazards in
+    functions reaching ``jax.jit``/``pjit``/``shard_map``;
+  - ``locks``      — GL03x: ``# guarded-by:`` field annotations checked
+    against actual lock scopes + the cross-module lock-ordering graph;
+  - ``telemetry``  — GL04x: every ``.event(...)`` call site checked
+    against the ``obs/schema.py`` registry;
+  - ``runner``     — baseline-aware CLI (``scripts/lint_graft.py``,
+    ``python -m building_llm_from_scratch_tpu.analysis``);
+  - ``runtime``    — the dynamic twins: ``LockOrderSanitizer`` (records
+    real acquisition orders, catches inversions and over-threshold hold
+    times) and the transfer-guard sentry proving a steady-state engine
+    tick / train step performs zero implicit device->host transfers.
+
+Stdlib-only by design: the static passes import neither jax nor numpy,
+so the lint gate runs in milliseconds before the test suite spins up.
+"""
+
+from building_llm_from_scratch_tpu.analysis.base import (
+    Finding,
+    ParsedModule,
+    RULES,
+)
+
+__all__ = ["Finding", "ParsedModule", "RULES"]

@@ -1,0 +1,5 @@
+"""``python -m building_llm_from_scratch_tpu.analysis`` — graft-lint."""
+
+from building_llm_from_scratch_tpu.analysis.runner import main
+
+raise SystemExit(main())

@@ -1,0 +1,256 @@
+"""graft-lint core: parsed-module model, findings, and suppressions.
+
+The analyzers (``hostsync``/``jitpurity``/``locks``/``telemetry``) are
+stdlib-``ast`` passes over ``ParsedModule`` objects. Everything comment-
+shaped (suppressions, ``# guarded-by:`` / ``# holds:`` / ``# graft:
+hot-path`` annotations) lives here because ``ast`` drops comments: the
+annotations are recovered from the raw source lines and joined to nodes
+by line number.
+
+Inline suppression grammar (same line as the finding, or the line above
+when the flagged line has no room):
+
+    x = float(lr)            # graft-ok: GL011 cadence-time fetch
+    y = np.asarray(v)        # graft-ok: GL01x host numpy, not device
+
+A suppression names one or more rule ids (comma-separated); a family id
+ending in ``x`` (``GL01x``) matches every rule in the family. Suppressed
+findings are dropped before baseline comparison — the baseline is for
+repo-level debt with reasons, suppressions for point decisions the
+adjacent code explains.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import importlib.util
+import os
+import re
+import sys
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+
+def load_schema_module():
+    """Load ``obs/schema.py`` by FILE PATH, bypassing the ``obs`` package
+    ``__init__`` (which imports the jax-backed observability stack —
+    ~1s and a hard jax dependency, measured). This keeps the lint gate
+    and the telemetry renderer genuinely stdlib-only. When the package
+    is already imported (tests, in-process use), the real module is
+    reused so identity checks (``trace.TICK_PHASES is
+    schema.TICK_PHASES``) keep holding."""
+    mod = sys.modules.get("building_llm_from_scratch_tpu.obs.schema")
+    if mod is not None:
+        return mod
+    name = "_graft_obs_schema"
+    mod = sys.modules.get(name)
+    if mod is not None:
+        return mod
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "obs", "schema.py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    # registered BEFORE exec: dataclass processing resolves the module's
+    # (string, via __future__ annotations) field types through
+    # sys.modules[cls.__module__]
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+#: rule id -> one-line description (the catalog; README mirrors it).
+RULES: Dict[str, str] = {
+    "GL011": "implicit device->host scalar conversion (float/int/bool) "
+             "in a registered hot path",
+    "GL012": "implicit device->host array materialization (np.asarray/"
+             "np.array/.tolist) in a registered hot path",
+    "GL013": ".item() device fetch in a registered hot path",
+    "GL021": "print() side effect inside a jit-compiled function",
+    "GL022": "wall-clock (time.*) call inside a jit-compiled function",
+    "GL023": "host RNG (random.*/np.random.*) inside a jit-compiled "
+             "function",
+    "GL024": "Python branching on a traced (non-static) argument inside "
+             "a jit-compiled function",
+    "GL025": "closure/state mutation (global/nonlocal/self.attr write) "
+             "inside a jit-compiled function",
+    "GL026": "jax.jit of a callable constructed inside a function "
+             "(fresh jit cache per call: recompiles every invocation)",
+    "GL031": "field annotated '# guarded-by: <lock>' touched outside "
+             "the named lock",
+    "GL032": "lock-acquisition ordering cycle (deadlock hazard)",
+    "GL033": "guarded-by annotation names a lock the class never defines",
+    "GL041": "telemetry event kind not in the obs/schema.py registry",
+    "GL042": "telemetry event field not declared for its kind in "
+             "obs/schema.py",
+    "GL043": "telemetry event missing a required field at the call site",
+    "GL044": "private redeclaration of an obs/schema.py table "
+             "(schema drift hazard)",
+}
+
+_SUPPRESS_RE = re.compile(r"#\s*graft-ok:\s*([^#\n]+)")
+_RULE_TOKEN_RE = re.compile(r"^GL\d+x?$")
+_GUARDED_RE = re.compile(
+    r"#\s*guarded-by:\s*(\w+)\s*(\[writes\])?")
+_HOLDS_RE = re.compile(r"#\s*holds:\s*([\w,\s]+)")
+_HOT_RE = re.compile(r"#\s*graft:\s*hot-path")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str                 # repo-relative, forward slashes
+    line: int
+    message: str
+    qualname: str = ""        # enclosing Class.method or function
+    text: str = ""            # stripped source line (fingerprint input)
+
+    @property
+    def fingerprint(self) -> str:
+        """Line-number-independent identity for baseline matching: a
+        finding survives unrelated edits above it, and moves with its
+        line's content + enclosing symbol."""
+        h = hashlib.sha256()
+        h.update("\0".join((self.rule, self.path, self.qualname,
+                            self.text)).encode())
+        return h.hexdigest()[:16]
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}"
+        q = f" [{self.qualname}]" if self.qualname else ""
+        return f"{loc}: {self.rule}{q} {self.message}"
+
+
+def _rule_matches(pattern: str, rule: str) -> bool:
+    pattern = pattern.strip()
+    if pattern.endswith("x"):
+        return rule.startswith(pattern[:-1])
+    return rule == pattern
+
+
+class ParsedModule:
+    """One source file: AST + the comment-derived annotation maps."""
+
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        # line -> set of suppressed rule patterns
+        self.suppressions: Dict[int, Set[str]] = {}
+        # line -> (lockname, writes_only) for `# guarded-by:` comments
+        self.guarded: Dict[int, Tuple[str, bool]] = {}
+        # line -> [locknames] for `# holds:` comments
+        self.holds: Dict[int, List[str]] = {}
+        # lines carrying `# graft: hot-path`
+        self.hot_lines: Set[int] = set()
+        for i, text in enumerate(self.lines, start=1):
+            if "#" not in text:
+                continue
+            m = _SUPPRESS_RE.search(text)
+            if m:
+                # leading comma/space-separated rule ids; everything from
+                # the first non-rule token on is the human reason
+                rules: Set[str] = set()
+                for tok in re.split(r"[\s,]+", m.group(1).strip()):
+                    if _RULE_TOKEN_RE.match(tok):
+                        rules.add(tok)
+                    elif tok:
+                        break
+                if rules:
+                    self.suppressions[i] = rules
+            m = _GUARDED_RE.search(text)
+            if m:
+                self.guarded[i] = (m.group(1), bool(m.group(2)))
+            m = _HOLDS_RE.search(text)
+            if m:
+                self.holds[i] = [p.strip() for p in
+                                 m.group(1).split(",") if p.strip()]
+            if _HOT_RE.search(text):
+                self.hot_lines.add(i)
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def suppressed(self, rule: str, lineno: int) -> bool:
+        """A finding is suppressed by a ``# graft-ok`` on its own line or
+        the line directly above (for flagged lines with no comment room)."""
+        for ln in (lineno, lineno - 1):
+            for pattern in self.suppressions.get(ln, ()):
+                if _rule_matches(pattern, rule):
+                    return True
+        return False
+
+    def holds_for_def(self, node: ast.AST) -> List[str]:
+        """``# holds: <lock>`` annotations attached to a function: on the
+        ``def`` line itself or the line directly above (decorator-free
+        defs put the comment above; long signatures put it on the line)."""
+        lineno = getattr(node, "lineno", 0)
+        out: List[str] = []
+        for ln in (lineno, lineno - 1):
+            out.extend(self.holds.get(ln, ()))
+        return out
+
+    def is_hot_def(self, node: ast.AST) -> bool:
+        lineno = getattr(node, "lineno", 0)
+        return lineno in self.hot_lines or (lineno - 1) in self.hot_lines
+
+    def finding(self, rule: str, node: ast.AST, message: str,
+                qualname: str = "") -> Optional[Finding]:
+        """Build a Finding unless an inline suppression covers it."""
+        lineno = getattr(node, "lineno", 0)
+        if self.suppressed(rule, lineno):
+            return None
+        return Finding(rule=rule, path=self.relpath, line=lineno,
+                       message=message, qualname=qualname,
+                       text=self.line_text(lineno))
+
+
+@dataclass
+class QualTracker:
+    """Tracks the Class.method qualname while walking nested defs."""
+
+    stack: List[str] = field(default_factory=list)
+
+    def push(self, name: str) -> None:
+        self.stack.append(name)
+
+    def pop(self) -> None:
+        self.stack.pop()
+
+    @property
+    def qualname(self) -> str:
+        return ".".join(self.stack)
+
+
+def call_name(node: ast.AST) -> str:
+    """Dotted name of a call target ('np.asarray', 'jax.jit',
+    'self._lock.acquire') — best-effort, '' when not name-shaped."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def iter_functions(tree: ast.Module):
+    """Yield (qualname, class_name_or_None, func_node) for every function
+    and method in the module, including nested ones."""
+
+    def walk(node, prefix: str, cls: Optional[str]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{child.name}"
+                yield qual, cls, child
+                yield from walk(child, qual + ".", cls)
+            elif isinstance(child, ast.ClassDef):
+                yield from walk(child, f"{prefix}{child.name}.",
+                                child.name if cls is None else cls)
+
+    yield from walk(tree, "", None)

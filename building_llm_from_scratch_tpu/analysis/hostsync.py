@@ -1,0 +1,161 @@
+"""GL01x — implicit device->host sync lint for registered hot paths.
+
+The repo's steady-state invariant (PR 2-4, guard-tested since): the step
+loop and the decode tick NEVER block the host on the device implicitly.
+Device values are fetched only at cadence boundaries, and the sanctioned
+fetch points use **explicit** ``jax.device_get`` — which this lint never
+flags, and which the runtime twin (``analysis/runtime.py``'s
+transfer-guard sentry) lets through while rejecting everything implicit.
+
+What gets scanned: the functions in ``HOT_PATHS`` below plus any function
+whose ``def`` line carries a ``# graft: hot-path`` comment. What gets
+flagged inside them:
+
+  - GL011: ``float(x)`` / ``int(x)`` / ``bool(x)`` on a non-literal — the
+    classic hidden sync (each one blocks until the dispatched program
+    finishes AND pays a device round trip);
+  - GL012: ``np.asarray(x)`` / ``np.array(x)`` / ``x.tolist()`` — bulk
+    implicit materialization;
+  - GL013: ``x.item()``.
+
+Static analysis cannot see types, so the rules are conservative: host-only
+conversions in a hot path need a ``# graft-ok: GL01x <why>`` suppression,
+which doubles as documentation that a reviewer asserted host-ness. One
+dataflow concession keeps the sanctioned idiom suppression-free: a name
+assigned from ``jax.device_get(...)`` is host-typed for the rest of the
+function, and conversions of it (or of subscripts of it) are not flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from building_llm_from_scratch_tpu.analysis.base import (
+    Finding,
+    ParsedModule,
+    call_name,
+    iter_functions,
+)
+
+#: Registered hot paths: repo-relative module path -> function qualnames.
+#: These are the loops where one implicit sync repeats thousands of times
+#: per second; everything else syncs at worst once per cadence/request.
+HOT_PATHS = {
+    "building_llm_from_scratch_tpu/training/trainer.py": {
+        "Trainer._epoch_steps",
+    },
+    "building_llm_from_scratch_tpu/serving/engine.py": {
+        "DecodeEngine.step",
+        "DecodeEngine._admit",
+        "DecodeEngine._accept_token",
+    },
+    "building_llm_from_scratch_tpu/data/prefetch.py": {
+        "Prefetcher._fill",
+        "Prefetcher.__next__",
+    },
+}
+
+_SCALAR_CASTS = {"float", "int", "bool"}
+_ARRAY_CALLS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+                "jax.device_get"}  # device_get handled as SANCTIONED below
+_DEVICE_GET = {"jax.device_get"}
+
+
+def _is_literal(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.UnaryOp):
+        return _is_literal(node.operand)
+    if isinstance(node, ast.BinOp):
+        return _is_literal(node.left) and _is_literal(node.right)
+    return False
+
+
+def _root_name(node: ast.AST) -> Optional[str]:
+    """The base Name of an expression like ``x``, ``x[i]``, ``x.attr``."""
+    while isinstance(node, (ast.Subscript, ast.Attribute)):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+class _HotFunctionChecker(ast.NodeVisitor):
+    def __init__(self, mod: ParsedModule, qualname: str):
+        self.mod = mod
+        self.qualname = qualname
+        self.findings: List[Finding] = []
+        # names proven host-resident: assigned from jax.device_get(...)
+        self.host_names: Set[str] = set()
+
+    def _emit(self, rule: str, node: ast.AST, message: str) -> None:
+        f = self.mod.finding(rule, node, message, self.qualname)
+        if f is not None:
+            self.findings.append(f)
+
+    def _arg_is_sanctioned(self, arg: ast.AST) -> bool:
+        """True for args that are provably host-side: a direct
+        ``jax.device_get(...)`` call, or (a subscript/attribute of) a
+        name previously assigned from one."""
+        if isinstance(arg, ast.Call) and call_name(arg.func) in _DEVICE_GET:
+            return True
+        root = _root_name(arg)
+        return root is not None and root in self.host_names
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        # dataflow-lite: `x = jax.device_get(...)` marks x host-resident
+        if (isinstance(node.value, ast.Call)
+                and call_name(node.value.func) in _DEVICE_GET):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    self.host_names.add(tgt.id)
+                elif isinstance(tgt, ast.Tuple):
+                    for elt in tgt.elts:
+                        if isinstance(elt, ast.Name):
+                            self.host_names.add(elt.id)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = call_name(node.func)
+        args = node.args
+        if name in _SCALAR_CASTS and args and not _is_literal(args[0]):
+            if not self._arg_is_sanctioned(args[0]):
+                self._emit(
+                    "GL011", node,
+                    f"{name}() may sync the device in a hot path — fetch "
+                    f"at cadence via jax.device_get, or suppress with a "
+                    f"reason if the value is host-resident")
+        elif name in _ARRAY_CALLS and name not in _DEVICE_GET:
+            if args and not self._arg_is_sanctioned(args[0]):
+                self._emit(
+                    "GL012", node,
+                    f"{name}() materializes implicitly in a hot path — "
+                    f"use explicit jax.device_get at the sanctioned fetch "
+                    f"point, or suppress with a reason")
+        elif (isinstance(node.func, ast.Attribute)
+              and node.func.attr == "item" and not node.args):
+            if not self._arg_is_sanctioned(node.func.value):
+                self._emit("GL013", node,
+                           ".item() is an implicit device fetch — use "
+                           "jax.device_get at a cadence boundary")
+        elif (isinstance(node.func, ast.Attribute)
+              and node.func.attr == "tolist" and not node.args):
+            if not self._arg_is_sanctioned(node.func.value):
+                self._emit("GL012", node,
+                           ".tolist() materializes implicitly in a hot "
+                           "path — use explicit jax.device_get")
+        self.generic_visit(node)
+
+
+def check_module(mod: ParsedModule) -> List[Finding]:
+    registered = HOT_PATHS.get(mod.relpath, set())
+    findings: List[Finding] = []
+    for qualname, _cls, node in iter_functions(mod.tree):
+        if qualname not in registered and not mod.is_hot_def(node):
+            continue
+        checker = _HotFunctionChecker(mod, qualname)
+        for stmt in node.body:
+            checker.visit(stmt)
+        findings.extend(checker.findings)
+    return findings

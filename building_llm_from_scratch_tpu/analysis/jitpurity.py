@@ -1,0 +1,275 @@
+"""GL02x — jit-purity and recompile-hazard lint.
+
+A function that reaches ``jax.jit`` / ``pjit`` / ``shard_map`` runs as a
+TRACE: Python executes once per (signature), and anything impure either
+silently freezes (wall-clock reads, host RNG) or silently multiplies
+(side effects re-run on every recompile). Worse, a *fresh callable*
+handed to ``jax.jit`` inside a function body defeats the jit cache
+entirely — the cache is keyed on the callable's identity, so every call
+of the enclosing function pays a full XLA compile ("Run LoRA Run"'s
+implementation-regression class; exactly what bit ``generate()``'s
+sliding-window fallback before this lint).
+
+Detection is two-phase per module:
+
+  1. find the jit reach set: functions named in ``jax.jit(f)`` /
+     ``pjit(f)`` / ``shard_map(f, ...)`` call sites (plain names and
+     ``self._method`` references), plus functions decorated with
+     ``@jax.jit`` / ``@functools.partial(jax.jit, ...)``. Static
+     argument names (``static_argnames=(...)``) are collected so GL024
+     exempts branching on them.
+  2. walk each reached function body for the GL021-025 hazards; GL026
+     fires at the call site itself when the jitted operand is a lambda
+     or an inner def of the enclosing function.
+
+Intentional trace-time effects (a debug print in a disabled code path, a
+deliberate trace counter) take ``# graft-ok: GL02x <why>`` suppressions.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from building_llm_from_scratch_tpu.analysis.base import (
+    Finding,
+    ParsedModule,
+    call_name,
+    iter_functions,
+)
+
+_JIT_CALLS = {"jax.jit", "jit", "pjit", "jax.pjit", "shard_map",
+              "jax.shard_map"}
+_TIME_CALLS = ("time.time", "time.perf_counter", "time.monotonic",
+               "time.process_time", "time.time_ns", "time.sleep")
+_HOST_RNG_PREFIXES = ("random.", "np.random.", "numpy.random.")
+
+
+def _static_argnames(call: ast.Call) -> Set[str]:
+    names: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for elt in ast.walk(kw.value):
+                if isinstance(elt, ast.Constant) and isinstance(
+                        elt.value, str):
+                    names.add(elt.value)
+    return names
+
+
+def _jit_operand(call: ast.Call) -> Optional[ast.AST]:
+    """The callable being jitted at this call site (first positional)."""
+    return call.args[0] if call.args else None
+
+
+def _collect_jit_reach(mod: ParsedModule) -> Tuple[
+        Dict[str, Set[str]], List[Tuple[ast.AST, str]],
+        List[Tuple[ast.Lambda, Set[str]]]]:
+    """(reached: func-or-method name -> static argnames,
+    hazards: [(node, message)] for GL026 fresh-callable sites,
+    lambdas: jitted lambda nodes + their static argnames).
+
+    Names are matched module-wide: ``jax.jit(self._decode_impl)`` marks
+    method ``_decode_impl`` of any class in the module (class-accurate
+    resolution would need full type inference; one module rarely reuses
+    a method name across classes with only one jitted)."""
+    reached: Dict[str, Set[str]] = {}
+    fresh: List[Tuple[ast.AST, str]] = []
+    lambdas: List[Tuple[ast.Lambda, Set[str]]] = []
+
+    # decorators: @jax.jit / @functools.partial(jax.jit, ...)
+    for qualname, _cls, fn in iter_functions(mod.tree):
+        for dec in fn.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            name = call_name(target)
+            statics: Set[str] = set()
+            if name in _JIT_CALLS:
+                pass
+            elif name in ("functools.partial", "partial") and isinstance(
+                    dec, ast.Call):
+                inner = call_name(dec.args[0]) if dec.args else ""
+                if inner not in _JIT_CALLS:
+                    continue
+                statics = _static_argnames(dec)
+            else:
+                continue
+            if isinstance(dec, ast.Call):
+                statics |= _static_argnames(dec)
+            reached.setdefault(fn.name, set()).update(statics)
+
+    # call sites: jax.jit(f) / shard_map(f, ...) anywhere in the module
+    enclosing: Dict[int, str] = {}
+    for qualname, _cls, fn in iter_functions(mod.tree):
+        for sub in ast.walk(fn):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)) and sub is not fn:
+                continue
+            enclosing.setdefault(id(sub), qualname)
+    inner_defs: Dict[str, Set[str]] = {}
+    for qualname, _cls, fn in iter_functions(mod.tree):
+        if "." in qualname:
+            outer = qualname.rsplit(".", 1)[0]
+            inner_defs.setdefault(outer, set()).add(fn.name)
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if call_name(node.func) not in _JIT_CALLS:
+            continue
+        operand = _jit_operand(node)
+        if operand is None:
+            continue
+        statics = _static_argnames(node)
+        qual = enclosing.get(id(node), "")
+        if isinstance(operand, ast.Lambda):
+            if qual:      # module-level lambda jit is built once — fine
+                fresh.append((
+                    node,
+                    "jax.jit of a lambda built inside a function: the "
+                    "jit cache keys on callable identity, so every call "
+                    "of the enclosing function recompiles — hoist the "
+                    "jitted function to module/init scope"))
+            lambdas.append((operand, statics))   # body purity-checked too
+            continue
+        name = call_name(operand)
+        if not name:
+            continue
+        short = name.split(".")[-1]
+        if name.startswith("self."):
+            reached.setdefault(short, set()).update(statics)
+            # methods jitted in __init__ are built once per object — the
+            # sanctioned pattern (serving engine); no GL026
+        elif qual and short in inner_defs.get(qual, set()):
+            # jit of a def nested in THIS function: when the enclosing
+            # function is itself a one-shot builder (make_train_step)
+            # this is the factory pattern and fine — but the builder's
+            # callers must cache, which the repo's Trainer does. Only a
+            # jit of a nested def inside a LOOP is certainly fresh; the
+            # conservative rule stays quiet here and GL026 covers
+            # lambdas, the unambiguous case.
+            reached.setdefault(short, set()).update(statics)
+        else:
+            reached.setdefault(short, set()).update(statics)
+    return reached, fresh, lambdas
+
+
+class _PurityChecker(ast.NodeVisitor):
+    def __init__(self, mod: ParsedModule, qualname: str,
+                 params: Set[str], statics: Set[str]):
+        self.mod = mod
+        self.qualname = qualname
+        self.traced = params - statics - {"self", "cls"}
+        self.findings: List[Finding] = []
+
+    def _emit(self, rule: str, node: ast.AST, message: str) -> None:
+        f = self.mod.finding(rule, node, message, self.qualname)
+        if f is not None:
+            self.findings.append(f)
+
+    # -- side effects -----------------------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = call_name(node.func)
+        if name == "print":
+            self._emit("GL021", node,
+                       "print() inside a jitted function runs at TRACE "
+                       "time only (and re-runs on every recompile) — use "
+                       "jax.debug.print for runtime values")
+        elif name in _TIME_CALLS:
+            self._emit("GL022", node,
+                       f"{name}() inside a jitted function freezes one "
+                       "trace-time value into the compiled program")
+        elif any(name.startswith(p) for p in _HOST_RNG_PREFIXES):
+            self._emit("GL023", node,
+                       f"{name}() is host RNG: the draw happens once at "
+                       "trace time and is baked into the program — use "
+                       "jax.random with a threaded key")
+        self.generic_visit(node)
+
+    # -- state mutation ---------------------------------------------------
+
+    def visit_Global(self, node: ast.Global) -> None:
+        self._emit("GL025", node,
+                   "global-variable write inside a jitted function is a "
+                   "trace-time side effect (happens once per compile, "
+                   "not per step)")
+
+    def visit_Nonlocal(self, node: ast.Nonlocal) -> None:
+        self._emit("GL025", node,
+                   "nonlocal write inside a jitted function is a "
+                   "trace-time side effect")
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Attribute) and isinstance(
+                    tgt.value, ast.Name) and tgt.value.id == "self":
+                self._emit(
+                    "GL025", node,
+                    f"self.{tgt.attr} assignment inside a jitted method "
+                    "mutates host state at trace time — return the value "
+                    "instead")
+        self.generic_visit(node)
+
+    # -- traced-arg branching ---------------------------------------------
+
+    def _test_on_traced(self, test: ast.AST) -> Optional[str]:
+        # is-None / isinstance / containment checks are structure checks,
+        # not value branches — pytree structure is static under jit
+        if isinstance(test, ast.Compare) and any(
+                isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+                for op in test.ops):
+            return None
+        for sub in ast.walk(test):
+            if isinstance(sub, ast.Call) and call_name(sub.func) in (
+                    "isinstance", "len", "hasattr", "getattr"):
+                return None
+            if isinstance(sub, ast.Name) and sub.id in self.traced:
+                return sub.id
+        return None
+
+    def visit_If(self, node: ast.If) -> None:
+        name = self._test_on_traced(node.test)
+        if name is not None:
+            self._emit(
+                "GL024", node,
+                f"Python `if` on traced argument '{name}': the branch is "
+                "resolved ONCE at trace time (TracerBoolConversionError "
+                "or a silently frozen branch) — use jnp.where/lax.cond, "
+                "or declare the argument static")
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        name = self._test_on_traced(node.test)
+        if name is not None:
+            self._emit(
+                "GL024", node,
+                f"Python `while` on traced argument '{name}' cannot "
+                "trace — use lax.while_loop or a static bound")
+        self.generic_visit(node)
+
+
+def check_module(mod: ParsedModule) -> List[Finding]:
+    reached, fresh, lambdas = _collect_jit_reach(mod)
+    findings: List[Finding] = []
+    for node, message in fresh:
+        f = mod.finding("GL026", node, message)
+        if f is not None:
+            findings.append(f)
+    for lam, statics in lambdas:
+        params = {a.arg for a in (lam.args.posonlyargs + lam.args.args
+                                  + lam.args.kwonlyargs)}
+        checker = _PurityChecker(mod, "<jitted lambda>", params, statics)
+        checker.visit(lam.body)
+        findings.extend(checker.findings)
+    if not reached:
+        return findings
+    for qualname, _cls, fn in iter_functions(mod.tree):
+        statics = reached.get(fn.name)
+        if statics is None:
+            continue
+        params = {a.arg for a in (fn.args.posonlyargs + fn.args.args
+                                  + fn.args.kwonlyargs)}
+        checker = _PurityChecker(mod, qualname, params, statics)
+        for stmt in fn.body:
+            checker.visit(stmt)
+        findings.extend(checker.findings)
+    return findings

@@ -1,0 +1,390 @@
+"""GL03x — lock-discipline lint: guarded-by annotations + ordering graph.
+
+PR 6 earned two rounds of wedged-lock fixes the hard way; this checker
+turns the discipline those fixes encode into machine-checked contracts:
+
+  - **GL031** — a field annotated ``# guarded-by: <lock>`` (on its
+    ``self.field = ...`` line, normally in ``__init__``) may only be
+    touched while the named lock is held. "Held" is established
+    lexically: a ``with self.<lock>:`` block (or an alias assigned
+    ``lock = self._lock`` earlier in the function), a
+    ``lock.acquire(...)`` call (held through the rest of the function —
+    the timed-acquire/finally-release pattern), or a ``# holds: <lock>``
+    annotation on the ``def`` line documenting that every caller holds
+    it. A ``[writes]`` qualifier (``# guarded-by: _restart_lock
+    [writes]``) checks stores only — the seqlock-style fields whose
+    racy reads are the design (generation stamps).
+  - **GL032** — the cross-module lock-acquisition graph: while holding
+    lock A, acquiring lock B adds edge A->B; a cycle means two threads
+    can deadlock by acquiring in opposite orders (the engine-lock /
+    queue-condvar / MetricLogger-RLock triangle is exactly PR 6's wedge
+    surface). Edges are collected lexically AND through one level of
+    call resolution: a call ``self.queue.put(...)`` while holding the
+    engine lock contributes the locks ``put`` acquires (matched by
+    method name across the scanned corpus).
+  - **GL033** — a ``guarded-by`` naming a lock the class never creates
+    (typo'd annotations must fail loudly, or the whole scheme rots).
+
+``threading.Condition(self._lock)`` registers the condition name as an
+ALIAS of the wrapped lock, so holding either satisfies the annotation
+(the request queue's ``_not_full`` over ``_lock`` pattern).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from building_llm_from_scratch_tpu.analysis.base import (
+    Finding,
+    ParsedModule,
+    call_name,
+)
+
+_LOCK_CTORS = {"threading.Lock", "threading.RLock", "Lock", "RLock"}
+_COND_CTORS = {"threading.Condition", "Condition"}
+
+
+@dataclass
+class ClassModel:
+    name: str
+    relpath: str
+    locks: Set[str] = field(default_factory=set)
+    #: condition/alias name -> canonical lock name
+    aliases: Dict[str, str] = field(default_factory=dict)
+    #: field -> (canonical lock, writes_only, anno line)
+    guarded: Dict[str, Tuple[str, bool, int]] = field(default_factory=dict)
+
+    def canonical(self, name: str) -> str:
+        return self.aliases.get(name, name)
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _collect_class(mod: ParsedModule, cls: ast.ClassDef) -> ClassModel:
+    model = ClassModel(cls.name, mod.relpath)
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        else:
+            continue
+        for tgt in targets:
+            attr = _self_attr(tgt)
+            if attr is None:
+                continue
+            if isinstance(value, ast.Call):
+                ctor = call_name(value.func)
+                if ctor in _LOCK_CTORS:
+                    model.locks.add(attr)
+                elif ctor in _COND_CTORS:
+                    wrapped = (_self_attr(value.args[0])
+                               if value.args else None)
+                    if wrapped:
+                        model.aliases[attr] = wrapped
+                    else:
+                        model.locks.add(attr)   # Condition() owns a lock
+            # the guarded-by comment may sit on any physical line of a
+            # multi-line assignment statement
+            for ln in range(node.lineno,
+                            (node.end_lineno or node.lineno) + 1):
+                anno = mod.guarded.get(ln)
+                if anno is not None:
+                    lockname, writes_only = anno
+                    model.guarded[attr] = (lockname, writes_only, ln)
+                    break
+    return model
+
+
+@dataclass
+class MethodFacts:
+    """What one method does with locks (for the ordering graph)."""
+
+    qualname: str
+    relpath: str
+    #: canonical locks this method acquires lexically (with/acquire)
+    acquires: List[Tuple[str, int]] = field(default_factory=list)
+    #: (held-lock, acquired-lock, line) lexical nesting edges
+    edges: List[Tuple[str, str, int]] = field(default_factory=list)
+    #: method names called while holding each lock: (held, callee, line)
+    calls_under: List[Tuple[str, str, int]] = field(default_factory=list)
+
+
+class _MethodChecker(ast.NodeVisitor):
+    def __init__(self, mod: ParsedModule, model: ClassModel,
+                 qualname: str, base_holds: Set[str]):
+        self.mod = mod
+        self.model = model
+        self.qualname = qualname
+        self.held: List[str] = sorted(base_holds)
+        # local alias -> canonical lock ('lock = self._lock' pattern)
+        self.local_aliases: Dict[str, str] = {}
+        self.findings: List[Finding] = []
+        self.facts = MethodFacts(f"{model.name}.{qualname.split('.')[-1]}",
+                                 mod.relpath)
+
+    # -- lock resolution --------------------------------------------------
+
+    def _as_lock(self, node: ast.AST) -> Optional[str]:
+        """Resolve an expression to a canonical lock of this class."""
+        attr = _self_attr(node)
+        if attr is not None:
+            if attr in self.model.locks or attr in self.model.aliases:
+                return self.model.canonical(attr)
+            return None
+        if isinstance(node, ast.Name) and node.id in self.local_aliases:
+            return self.local_aliases[node.id]
+        return None
+
+    def _note_acquire(self, lock: str, lineno: int) -> None:
+        self.facts.acquires.append((lock, lineno))
+        for held in self.held:
+            if held != lock:
+                self.facts.edges.append((held, lock, lineno))
+
+    # -- traversal ---------------------------------------------------------
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        lock = self._as_lock(node.value)
+        if lock is not None:
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    self.local_aliases[tgt.id] = lock
+        self._check_targets(node.targets)
+        self.visit(node.value)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._check_targets([node.target])
+        self.visit(node.value)
+
+    def visit_With(self, node: ast.With) -> None:
+        entered: List[str] = []
+        for item in node.items:
+            lock = self._as_lock(item.context_expr)
+            if lock is not None:
+                self._note_acquire(lock, node.lineno)
+                entered.append(lock)
+            else:
+                self.visit(item.context_expr)
+        self.held.extend(entered)
+        for stmt in node.body:
+            self.visit(stmt)
+        # remove exactly the with-entered locks: a timed `.acquire()`
+        # inside the body appends to `held` permanently (its release
+        # lives in a finally), so a blind tail-pop would drop THAT lock
+        # and leave the with-lock marked held past its block
+        for lock in entered:
+            for i in range(len(self.held) - 1, -1, -1):
+                if self.held[i] == lock:
+                    del self.held[i]
+                    break
+
+    def visit_Call(self, node: ast.Call) -> None:
+        # lock.acquire(...): the timed-acquire pattern — treated as held
+        # for the REST of the function (release lives in a finally)
+        if isinstance(node.func, ast.Attribute):
+            if node.func.attr == "acquire":
+                lock = self._as_lock(node.func.value)
+                if lock is not None:
+                    self._note_acquire(lock, node.lineno)
+                    self.held.append(lock)
+            elif self.held and node.func.attr not in ("acquire", "release"):
+                # method call while holding: graph fodder (resolved
+                # against the corpus in the cross-module pass)
+                for held in self.held:
+                    self.facts.calls_under.append(
+                        (held, node.func.attr, node.lineno))
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        attr = _self_attr(node)
+        if attr is not None and attr in self.model.guarded:
+            lockname, writes_only, _ = self.model.guarded[attr]
+            canonical = self.model.canonical(lockname)
+            is_store = isinstance(node.ctx, (ast.Store, ast.Del))
+            if (not writes_only or is_store) and canonical not in self.held:
+                kind = "written" if is_store else "read"
+                f = self.mod.finding(
+                    "GL031", node,
+                    f"self.{attr} is guarded-by {lockname} but {kind} "
+                    f"without it (hold the lock, annotate the function "
+                    f"'# holds: {lockname}', or suppress with a reason)",
+                    self.qualname)
+                if f is not None:
+                    self.findings.append(f)
+        self.generic_visit(node)
+
+    def _check_targets(self, targets: List[ast.AST]) -> None:
+        for tgt in targets:
+            self.visit(tgt)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        # nested defs inherit the held set at their definition point —
+        # the repo's nested closures (_fail_all's _kill) run synchronously
+        # inside the region that defined them
+        for stmt in node.body:
+            self.visit(stmt)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def check_module(mod: ParsedModule) -> Tuple[List[Finding],
+                                             List[MethodFacts]]:
+    findings: List[Finding] = []
+    facts: List[MethodFacts] = []
+    classes = [n for n in ast.walk(mod.tree)
+               if isinstance(n, ast.ClassDef)]
+    for cls in classes:
+        model = _collect_class(mod, cls)
+        if not model.guarded and not model.locks:
+            continue
+        # GL033: annotation names a lock the class never defines
+        for fld, (lockname, _w, lineno) in sorted(model.guarded.items()):
+            if (lockname not in model.locks
+                    and lockname not in model.aliases):
+                f = Finding(
+                    "GL033", mod.relpath, lineno,
+                    f"guarded-by names '{lockname}' but class "
+                    f"{model.name} defines no such lock",
+                    qualname=f"{model.name}.{fld}",
+                    text=mod.line_text(lineno))
+                if not mod.suppressed("GL033", lineno):
+                    findings.append(f)
+        for item in cls.body:
+            if not isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if item.name == "__init__":
+                continue        # construction precedes sharing
+            base_holds = {model.canonical(h)
+                          for h in mod.holds_for_def(item)}
+            checker = _MethodChecker(mod, model,
+                                     f"{model.name}.{item.name}",
+                                     base_holds)
+            for stmt in item.body:
+                checker.visit(stmt)
+            findings.extend(checker.findings)
+            # annotated holds count as an edge source for the graph:
+            # a function documented to run under L that acquires M
+            # contributes L->M even though the `with` is in its caller
+            facts.append(checker.facts)
+    return findings, facts
+
+
+def lock_order_findings(all_facts: List[MethodFacts],
+                        mods: Dict[str, ParsedModule]) -> List[Finding]:
+    """Cross-module pass: assemble the acquisition graph and flag cycles.
+
+    Nodes are ``relpath::Class.lock``; direct lexical nesting gives
+    edges, and one level of call resolution adds edges for
+    ``obj.method(...)`` calls made while holding a lock, where
+    ``method`` matches a scanned method that acquires locks of its own
+    class (method names are matched corpus-wide; an ambiguous name adds
+    an edge per candidate — over-approximation is the safe direction
+    for deadlock detection, and a justified false cycle can be
+    suppressed at the `with` site)."""
+    # method name -> [(node-prefix, [locks acquired])]
+    by_name: Dict[str, List[Tuple[str, List[str]]]] = {}
+    for mf in all_facts:
+        cls_prefix = f"{mf.relpath}::{mf.qualname.rsplit('.', 1)[0]}"
+        method = mf.qualname.rsplit(".", 1)[-1]
+        if mf.acquires:
+            by_name.setdefault(method, []).append(
+                (cls_prefix, sorted({lk for lk, _ in mf.acquires})))
+
+    edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+
+    def add_edge(a: str, b: str, relpath: str, line: int) -> None:
+        if a != b:
+            edges.setdefault((a, b), (relpath, line))
+
+    for mf in all_facts:
+        cls_prefix = f"{mf.relpath}::{mf.qualname.rsplit('.', 1)[0]}"
+        for held, acquired, line in mf.edges:
+            add_edge(f"{cls_prefix}.{held}", f"{cls_prefix}.{acquired}",
+                     mf.relpath, line)
+        for held, callee, line in mf.calls_under:
+            for target_prefix, locks in by_name.get(callee, ()):
+                # same-class edges too: a call-mediated acquisition
+                # (method A holds L1, calls B which takes L2) is never
+                # visible lexically, and intra-class cycles are the
+                # common engine shape; duplicate edges are harmless
+                # (first site wins)
+                for lk in locks:
+                    add_edge(f"{cls_prefix}.{held}",
+                             f"{target_prefix}.{lk}", mf.relpath, line)
+
+    # cycle detection: iterative DFS over the edge set
+    graph: Dict[str, List[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, []).append(b)
+    findings: List[Finding] = []
+    seen_cycles: Set[Tuple[str, ...]] = set()
+    WHITE, GREY, BLACK = 0, 1, 2
+    color: Dict[str, int] = {}
+
+    def dfs(start: str) -> None:
+        stack: List[Tuple[str, int]] = [(start, 0)]
+        path: List[str] = []
+        while stack:
+            node, idx = stack.pop()
+            if idx == 0:
+                if color.get(node, WHITE) == BLACK:
+                    continue
+                color[node] = GREY
+                path.append(node)
+            nbrs = graph.get(node, [])
+            if idx < len(nbrs):
+                stack.append((node, idx + 1))
+                nxt = nbrs[idx]
+                c = color.get(nxt, WHITE)
+                if c == GREY:
+                    i = path.index(nxt)
+                    cycle = tuple(path[i:])
+                    key = tuple(sorted(cycle))
+                    if key not in seen_cycles:
+                        seen_cycles.add(key)
+                        # every edge of the cycle: suppressing ANY of
+                        # them (a `# graft-ok: GL032 <why>` at the
+                        # acquire site) dismisses the whole cycle — the
+                        # reviewer asserted that edge is infeasible
+                        ring = list(cycle) + [nxt]
+                        sites = [edges[(a, b)]
+                                 for a, b in zip(ring, ring[1:])
+                                 if (a, b) in edges]
+                        suppressed = any(
+                            mods.get(rp) is not None
+                            and mods[rp].suppressed("GL032", ln)
+                            for rp, ln in sites)
+                        relpath, line = (sites[-1] if sites
+                                         else (nxt.split("::")[0], 0))
+                        pretty = " -> ".join(
+                            n.split("::")[-1] for n in ring)
+                        mod = mods.get(relpath)
+                        if not suppressed:
+                            findings.append(Finding(
+                                "GL032", relpath, line,
+                                f"lock-acquisition cycle: {pretty} — "
+                                "two threads taking these in opposite "
+                                "orders deadlock",
+                                qualname="",
+                                text=(mod.line_text(line)
+                                      if mod else "")))
+                elif c == WHITE:
+                    stack.append((nxt, 0))
+            else:
+                color[node] = BLACK
+                path.pop()
+
+    for node in sorted(graph):
+        if color.get(node, WHITE) == WHITE:
+            dfs(node)
+    return findings

@@ -1,0 +1,294 @@
+"""graft-lint runner: discovery, baseline, CLI.
+
+Usage (equivalently via ``scripts/lint_graft.py`` or
+``python -m building_llm_from_scratch_tpu.analysis``):
+
+    lint_graft.py                      # repo scan vs checked-in baseline
+    lint_graft.py --json out.json      # machine-readable findings
+    lint_graft.py --update-baseline    # re-baseline (new entries marked)
+    lint_graft.py path1.py path2.py    # scan specific files (no baseline)
+
+Exit status: 0 when every finding is suppressed or baselined, 1 when a
+NEW finding exists — the CI gate (``scripts/ci_quick.sh``) runs this
+before the tier-1 suite, so invariant regressions fail fast and cheap.
+
+The baseline (``analysis/baseline.json``) is keyed on content
+fingerprints (rule + path + enclosing symbol + source line text), so
+entries survive unrelated edits and line drift but die with the code
+they describe. Every entry carries a ``reason``: baselining is an
+explicit, reviewed decision, never a silent default — entries added by
+``--update-baseline`` get a loud ``UNREVIEWED`` reason that a human must
+replace.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+from building_llm_from_scratch_tpu.analysis import (
+    hostsync,
+    jitpurity,
+    locks,
+    telemetry,
+)
+from building_llm_from_scratch_tpu.analysis.base import (
+    Finding,
+    ParsedModule,
+    RULES,
+)
+
+#: directories scanned by default (relative to the repo root)
+DEFAULT_SCAN = ("building_llm_from_scratch_tpu", "scripts")
+#: path fragments never scanned (fixtures hold SEEDED violations)
+EXCLUDE_PARTS = ("tests/fixtures", "/fixtures/", "__pycache__")
+
+UNREVIEWED = "UNREVIEWED — added by --update-baseline; justify or fix"
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "baseline.json")
+
+
+def discover(root: str, paths: Optional[List[str]] = None) -> List[str]:
+    out: List[str] = []
+    if paths:
+        for p in paths:
+            ap = os.path.abspath(p)
+            if os.path.isdir(ap):
+                out.extend(discover(root, [
+                    os.path.join(ap, n) for n in sorted(os.listdir(ap))]))
+            elif ap.endswith(".py"):
+                out.append(ap)
+        return out
+    for top in DEFAULT_SCAN:
+        base = os.path.join(root, top)
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames.sort()
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for name in sorted(filenames):
+                if not name.endswith(".py"):
+                    continue
+                full = os.path.join(dirpath, name)
+                rel = os.path.relpath(full, root).replace(os.sep, "/")
+                if any(part in rel for part in EXCLUDE_PARTS):
+                    continue
+                out.append(full)
+    return out
+
+
+def parse_modules(root: str, files: List[str]) -> List[ParsedModule]:
+    mods: List[ParsedModule] = []
+    for path in files:
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            mods.append(ParsedModule(path, rel, source))
+        except (OSError, SyntaxError) as e:
+            print(f"graft-lint: cannot parse {rel}: {e}", file=sys.stderr)
+    return mods
+
+
+def run_checkers(mods: List[ParsedModule]) -> List[Finding]:
+    findings: List[Finding] = []
+    all_lock_facts = []
+    by_rel = {m.relpath: m for m in mods}
+    for mod in mods:
+        findings.extend(hostsync.check_module(mod))
+        findings.extend(jitpurity.check_module(mod))
+        lock_findings, facts = locks.check_module(mod)
+        findings.extend(lock_findings)
+        all_lock_facts.extend(facts)
+        findings.extend(telemetry.check_module(mod))
+    findings.extend(locks.lock_order_findings(all_lock_facts, by_rel))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+# -- baseline ---------------------------------------------------------------
+
+def load_baseline(path: str) -> Dict[str, dict]:
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return {e["fingerprint"]: e for e in data.get("entries", [])}
+
+
+def save_baseline(path: str, findings: List[Finding],
+                  previous: Dict[str, dict]) -> int:
+    entries = []
+    for f in findings:
+        prev = previous.get(f.fingerprint)
+        entries.append({
+            "fingerprint": f.fingerprint,
+            "rule": f.rule,
+            "path": f.path,
+            "line": f.line,
+            "qualname": f.qualname,
+            "text": f.text,
+            "message": f.message,
+            "reason": (prev or {}).get("reason", UNREVIEWED),
+        })
+    entries.sort(key=lambda e: (e["path"], e["line"], e["rule"]))
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"comment": "graft-lint baseline: every entry is "
+                              "ACCEPTED DEBT with a reason; new findings "
+                              "fail the gate until fixed or justified "
+                              "here.",
+                   "entries": entries}, f, indent=1)
+        f.write("\n")
+    return len(entries)
+
+
+def split_baselined(findings: List[Finding], baseline: Dict[str, dict]
+                    ) -> Tuple[List[Finding], List[Finding], List[str]]:
+    """(new, baselined, stale_fingerprints)."""
+    new: List[Finding] = []
+    old: List[Finding] = []
+    seen = set()
+    for f in findings:
+        if f.fingerprint in baseline:
+            old.append(f)
+            seen.add(f.fingerprint)
+        else:
+            new.append(f)
+    stale = sorted(set(baseline) - seen)
+    return new, old, stale
+
+
+# -- CLI --------------------------------------------------------------------
+
+def per_rule_counts(findings: List[Finding]) -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for f in findings:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    return counts
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="lint_graft",
+        description="graft-lint: static invariant analysis (GL01x "
+                    "host-sync, GL02x jit purity, GL03x lock "
+                    "discipline, GL04x telemetry schema).")
+    p.add_argument("paths", nargs="*",
+                   help="files/dirs to scan (default: the package + "
+                        "scripts, vs the checked-in baseline)")
+    p.add_argument("--baseline", default=None,
+                   help="baseline JSON (default: analysis/baseline.json; "
+                        "'none' disables baselining)")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite the baseline from the current findings "
+                        "(keeps existing reasons; new entries are marked "
+                        "UNREVIEWED)")
+    p.add_argument("--json", default=None, metavar="OUT",
+                   help="write machine-readable findings JSON ('-' for "
+                        "stdout)")
+    p.add_argument("--rules", action="store_true",
+                   help="print the rule catalog and exit")
+    args = p.parse_args(argv)
+
+    if args.rules:
+        for rule in sorted(RULES):
+            print(f"{rule}  {RULES[rule]}")
+        return 0
+
+    root = repo_root()
+    explicit_paths = bool(args.paths)
+    if args.update_baseline and explicit_paths and not args.baseline:
+        # a partial scan would REWRITE the full repo baseline from only
+        # the scanned files, silently deleting every other entry (and
+        # its reviewed reason)
+        print("graft-lint: refusing --update-baseline with explicit "
+              "paths — a partial scan would clobber the checked-in "
+              "baseline. Run a full scan, or pass an explicit "
+              "--baseline file for the partial set.", file=sys.stderr)
+        return 2
+    files = discover(root, args.paths or None)
+    mods = parse_modules(root, files)
+    findings = run_checkers(mods)
+
+    baseline_path = args.baseline or default_baseline_path()
+    use_baseline = baseline_path != "none" and not explicit_paths
+    baseline = load_baseline(baseline_path) if use_baseline else {}
+
+    if args.update_baseline:
+        n = save_baseline(baseline_path, findings, baseline)
+        print(f"graft-lint: baseline updated: {n} entrie(s) at "
+              f"{os.path.relpath(baseline_path, root)}")
+        unreviewed = sum(
+            1 for e in load_baseline(baseline_path).values()
+            if e["reason"] == UNREVIEWED)
+        if unreviewed:
+            print(f"graft-lint: {unreviewed} entrie(s) are UNREVIEWED — "
+                  f"edit the baseline to justify them (no silent "
+                  f"suppressions)")
+        return 0
+
+    new, old, stale = split_baselined(findings, baseline)
+
+    payload = {
+        "n_findings": len(findings),
+        "n_new": len(new),
+        "n_baselined": len(old),
+        "stale_baseline_entries": stale,
+        "per_rule": per_rule_counts(findings),
+        "per_rule_new": per_rule_counts(new),
+        "findings": [
+            {"rule": f.rule, "path": f.path, "line": f.line,
+             "qualname": f.qualname, "message": f.message,
+             "fingerprint": f.fingerprint,
+             "baselined": f.fingerprint in baseline}
+            for f in findings],
+    }
+    json_to_stdout = args.json == "-"
+    if args.json:
+        text = json.dumps(payload, indent=1)
+        if json_to_stdout:
+            print(text)
+        else:
+            with open(args.json, "w", encoding="utf-8") as f:
+                f.write(text + "\n")
+
+    # with `--json -` stdout must stay pure JSON: the human-readable
+    # findings + summary move to stderr
+    report = sys.stderr if json_to_stdout else sys.stdout
+
+    def say(msg: str) -> None:
+        print(msg, file=report)
+
+    for f in new:
+        say(f.render())
+    # per-rule counts ALWAYS print, so two gate logs diff cleanly
+    counts = per_rule_counts(findings)
+    new_counts = per_rule_counts(new)
+    summary = ", ".join(
+        f"{rule}={counts[rule]}"
+        + (f"(+{new_counts[rule]} new)" if rule in new_counts else "")
+        for rule in sorted(counts)) or "clean"
+    say(f"graft-lint: {len(mods)} files, {len(findings)} finding(s) "
+        f"[{summary}], {len(old)} baselined, {len(new)} new")
+    if stale:
+        say(f"graft-lint: {len(stale)} stale baseline entrie(s) — the "
+            f"debt was paid; run --update-baseline to drop them")
+    if new:
+        say("graft-lint: FAIL — fix the findings above, suppress "
+            "inline with '# graft-ok: <rule> <why>', or baseline with "
+            "a reason via --update-baseline")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,137 @@
+"""GL04x — telemetry-schema lint: event call sites vs obs/schema.py.
+
+Every ``.event("kind", ...)`` / ``emit_event("kind", ...)`` call site in
+the package is checked against the declared registry:
+
+  - **GL041** — the kind is not registered (a typo'd event name would
+    otherwise produce rows no consumer ever joins);
+  - **GL042** — an explicit keyword names a field the kind does not
+    declare (drift between emitter and the renderer/trace consumers);
+  - **GL043** — a required field is missing. Only checkable when the
+    call passes no ``**kwargs`` (dynamic payloads skip this check but
+    still get their explicit keywords validated);
+  - **GL044** — a module outside ``obs/schema.py`` re-declares one of
+    the schema's table constants (``TICK_PHASES`` & co): the exact
+    drift-prone-copy failure mode PR 7's review caught by hand.
+
+Only literal-string kinds are checked; a dynamic first argument is
+invisible to static analysis (none exist in the repo today — keeping it
+that way is the point of the lint).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from building_llm_from_scratch_tpu.analysis.base import (
+    Finding,
+    ParsedModule,
+    call_name,
+    iter_functions,
+    load_schema_module,
+)
+
+# loaded by file path so the lint gate stays stdlib-only (a package
+# import of obs.schema would initialize obs/__init__ and pull in jax)
+_SCHEMA = load_schema_module()
+EVENTS = _SCHEMA.EVENTS
+ALWAYS_ALLOWED_FIELDS = _SCHEMA.ALWAYS_ALLOWED_FIELDS
+
+#: attribute / function names whose calls emit an event row with the
+#: kind as first positional argument
+_EVENT_ATTRS = {"event"}
+_EVENT_FUNCS = {"emit_event"}
+
+#: schema-owned table constants: redefining one of these outside the
+#: schema module is GL044
+_SCHEMA_TABLES = {"TICK_PHASES", "TRAIN_SEGMENTS", "INCIDENT_EVENTS",
+                  "REQUEST_EVENTS", "SERVING_LIFECYCLE_EVENTS",
+                  "SPAN_NAMES", "REQUEST_SPAN_PHASES"}
+
+_SCHEMA_MODULE = "building_llm_from_scratch_tpu/obs/schema.py"
+
+
+def _is_event_call(node: ast.Call) -> bool:
+    func = node.func
+    if isinstance(func, ast.Attribute) and func.attr in _EVENT_ATTRS:
+        # exclude unrelated .event attributes: require the object to be
+        # name-shaped metrics plumbing (sink / self.metrics_sink /
+        # get_metrics() / logger); conservative — a miss here is a
+        # false negative, not a false positive
+        base = func.value
+        if isinstance(base, ast.Call):
+            return call_name(base.func).endswith("get_metrics")
+        name = call_name(base)
+        return name.split(".")[-1] in ("sink", "metrics_sink", "metrics",
+                                       "logger", "_global_logger", "m")
+    if isinstance(func, ast.Name):
+        return func.id in _EVENT_FUNCS
+    return False
+
+
+def _qual_for(mod: ParsedModule, node: ast.AST) -> str:
+    best = ""
+    target = getattr(node, "lineno", 0)
+    for qualname, _cls, fn in iter_functions(mod.tree):
+        if fn.lineno <= target <= (fn.end_lineno or fn.lineno):
+            if len(qualname) > len(best) or not best:
+                best = qualname
+    return best
+
+
+def check_module(mod: ParsedModule) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def emit(rule: str, node: ast.AST, message: str) -> None:
+        f = mod.finding(rule, node, message, _qual_for(mod, node))
+        if f is not None:
+            findings.append(f)
+
+    # GL044: schema-table redeclaration outside the schema module
+    if mod.relpath != _SCHEMA_MODULE:
+        for node in mod.tree.body:
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets = [node.target]
+            for tgt in targets:
+                if isinstance(tgt, ast.Name) and tgt.id in _SCHEMA_TABLES:
+                    emit("GL044", node,
+                         f"private copy of schema table {tgt.id} — "
+                         f"import it from obs/schema.py instead "
+                         f"(drift here is invisible to consumers)")
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call) or not _is_event_call(node):
+            continue
+        if not node.args:
+            continue
+        kind_node = node.args[0]
+        if not (isinstance(kind_node, ast.Constant)
+                and isinstance(kind_node.value, str)):
+            continue                      # dynamic kind: not checkable
+        kind = kind_node.value
+        spec = EVENTS.get(kind)
+        if spec is None:
+            emit("GL041", node,
+                 f"event kind '{kind}' is not registered in "
+                 f"obs/schema.py — declare an EventSpec for it")
+            continue
+        explicit = {kw.arg for kw in node.keywords if kw.arg is not None}
+        has_dynamic = any(kw.arg is None for kw in node.keywords)
+        if not spec.open_fields:
+            unknown = explicit - spec.known_fields()
+            for fieldname in sorted(unknown):
+                emit("GL042", node,
+                     f"event '{kind}' does not declare field "
+                     f"'{fieldname}' — add it to the EventSpec or fix "
+                     f"the call site")
+        if not has_dynamic:
+            missing = spec.required - explicit - ALWAYS_ALLOWED_FIELDS
+            if missing:
+                emit("GL043", node,
+                     f"event '{kind}' missing required field(s) "
+                     f"{sorted(missing)}")
+    return findings

@@ -22,7 +22,7 @@ LLaMA) is fixed by taking pad/eos ids from the model config.
 
 from __future__ import annotations
 
-from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+from typing import Dict, Iterator, List, Sequence, Tuple
 
 import numpy as np
 

@@ -89,8 +89,14 @@ class Prefetcher:
         self._src = iter(source)
         self._q: "queue.Queue[Any]" = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
-        self._exc: Optional[BaseException] = None
-        self._closed = False
+        # worker -> consumer handshake state: _exc is written by the
+        # worker and read by the consumer after the _DONE sentinel; the
+        # lock makes the pair safe against a concurrent close() too
+        # (previously close() was check-then-set racy from a second
+        # thread — caught by graft-lint GL031 once annotated)
+        self._lock = threading.Lock()
+        self._exc: Optional[BaseException] = None   # guarded-by: _lock
+        self._closed = False                        # guarded-by: _lock
         self._finished = False
         # telemetry counters (read by the trainer at logging cadence)
         self.stalls = 0
@@ -124,7 +130,8 @@ class Prefetcher:
                 if not self._put(item):
                     return
         except BaseException as e:          # noqa: BLE001 — re-raised at pop
-            self._exc = e
+            with self._lock:
+                self._exc = e
         finally:
             # always terminate the stream: the consumer's blocking get()
             # must wake whether the source ended, raised, or was cancelled
@@ -151,8 +158,9 @@ class Prefetcher:
         if item is _DONE:
             self._finished = True
             self._thread.join(timeout=5.0)
-            if self._exc is not None:
+            with self._lock:
                 exc, self._exc = self._exc, None
+            if exc is not None:
                 raise exc
             raise StopIteration
         if would_stall:
@@ -179,11 +187,14 @@ class Prefetcher:
                 "fill_sum": self.fill_sum}
 
     def close(self) -> None:
-        """Cancel and join the worker. Idempotent; safe mid-iteration
+        """Cancel and join the worker. Idempotent — atomically so: two
+        threads racing close() (epoch teardown vs an unwinding caller)
+        elect exactly one to drain and join. Safe mid-iteration
         (preemption stop, watchdog halt, exception unwind)."""
-        if self._closed:
-            return
-        self._closed = True
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
         self._finished = True
         self._stop.set()
         # drain so a worker blocked in put() (full queue) cycles its

@@ -99,6 +99,16 @@ def _bucket(n: int, step: int = 64, lo: int = 32) -> int:
     return max(lo, -(-n // step) * step)
 
 
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _forward_window(params, cfg: ModelConfig, tokens: jnp.ndarray):
+    """Full forward over one padded window (the sliding-window fallback's
+    per-token program). Module-level jit on purpose: the jit cache keys
+    on the callable's identity, so the previous ``jax.jit(lambda ...)``
+    built inside ``generate()`` recompiled this forward on EVERY
+    fallback call (graft-lint GL026)."""
+    return forward(params, cfg, tokens)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("cfg", "budget", "temperature", "top_k", "eos_id",
@@ -254,7 +264,7 @@ def generate(params, cfg: ModelConfig, token_ids, max_new_tokens: int,
     # ``context_size`` are right-padded (causality makes the padding inert)
     # and the logits are read at the true last position. Without this, every
     # growing prompt length would trigger a fresh XLA compile.
-    fwd = jax.jit(lambda p, t: forward(p, cfg, t))
+    fwd = lambda p, t: _forward_window(p, cfg, t)  # noqa: E731
     ids = np.asarray(token_ids)
     done = np.zeros((B,), bool)
     n_gen = np.zeros((B,), np.int32)

@@ -58,11 +58,10 @@ import threading
 import time
 from typing import Any, Dict, IO, Optional
 
+from building_llm_from_scratch_tpu.obs.schema import SCHEMA_VERSION
 from building_llm_from_scratch_tpu.utils.logging import setup_logger
 
 logger = setup_logger(__name__)
-
-SCHEMA_VERSION = 3          # v3: + "span" row type (request/tick tracing)
 
 
 def _is_coordinator() -> bool:
@@ -137,10 +136,10 @@ class Histogram:
         self.bounds = tuple(sorted(float(b) for b in bounds))
         if not self.bounds:
             raise ValueError("histogram needs at least one bucket bound")
-        self._counts = [0] * (len(self.bounds) + 1)   # +Inf tail bucket
-        self.count = 0
-        self.sum = 0.0
         self._lock = threading.Lock()
+        self._counts = [0] * (len(self.bounds) + 1)   # guarded-by: _lock
+        self.count = 0                                # guarded-by: _lock
+        self.sum = 0.0                                # guarded-by: _lock
 
     def observe(self, value: float) -> None:
         value = float(value)
@@ -151,7 +150,8 @@ class Histogram:
             self.sum += value
 
     def __len__(self) -> int:                 # observations, not buckets
-        return self.count
+        with self._lock:
+            return self.count
 
     def snapshot(self) -> Dict[str, Any]:
         """{"buckets": [(le, cumulative_count), ..., ("+Inf", n)],
@@ -216,10 +216,11 @@ class RollingRatio:
             raise ValueError("window_s > 0 and n_buckets >= 1 required")
         self.window_s = float(window_s)
         self.bucket_s = self.window_s / int(n_buckets)
-        # bucket index -> [total, misses]
-        self._buckets: Dict[int, list] = {}
         self._lock = threading.Lock()
+        # bucket index -> [total, misses]
+        self._buckets: Dict[int, list] = {}   # guarded-by: _lock
 
+    # holds: _lock
     def _expire(self, now: float) -> None:
         horizon = now - self.window_s
         dead = [k for k in self._buckets
@@ -312,9 +313,6 @@ class MetricLogger:
                  coordinator_only: bool = True):
         self.jsonl_path = jsonl_path
         self.coordinator_only = coordinator_only
-        self.counters: Dict[str, float] = {}
-        self.gauges: Dict[str, float] = {}
-        self._timings: Dict[str, float] = {}
         # REENTRANT: GracefulStopper's signal handler emits an event, and
         # the signal can land while THIS thread already holds the lock
         # inside a write — a plain Lock would self-deadlock. Reentry is
@@ -322,14 +320,17 @@ class MetricLogger:
         # terminated write, so an interleaved handler row never splits a
         # line.
         self._lock = threading.RLock()
-        self._file: Optional[IO[str]] = None
-        self._closed = False
-        self._header_written = False
+        self.counters: Dict[str, float] = {}      # guarded-by: _lock
+        self.gauges: Dict[str, float] = {}        # guarded-by: _lock
+        self._timings: Dict[str, float] = {}      # guarded-by: _lock
+        self._file: Optional[IO[str]] = None      # guarded-by: _lock
+        self._closed = False                      # guarded-by: _lock
+        self._header_written = False              # guarded-by: _lock
         # rows emitted before the header (build-time fetch/retry events —
         # the run metadata needs the built components) are buffered and
         # flushed right after it, keeping the header the first line
-        self._pre_header: list = []
-        self._last_step = -1
+        self._pre_header: list = []               # guarded-by: _lock
+        self._last_step = -1                      # guarded-by: _lock
 
     # -- aggregation -----------------------------------------------------
 
@@ -350,6 +351,7 @@ class MetricLogger:
 
     # -- sink ------------------------------------------------------------
 
+    # holds: _lock
     def _writable(self) -> bool:
         # a closed sink stays closed: a late write (stall-detector thread
         # firing during teardown) must not reopen the path — that would
@@ -361,10 +363,13 @@ class MetricLogger:
     def _write_row(self, row: Dict[str, Any]) -> None:
         """Append one row. Never raises: telemetry failure must not take
         down the training loop it observes."""
-        if not self._writable():
-            return
         try:
             with self._lock:
+                # writability is decided under the lock: a close() racing
+                # this write either lands before (row dropped) or after
+                # (row flushed) — never between check and write
+                if not self._writable():
+                    return
                 if not self._header_written and row.get("type") != "header":
                     self._pre_header.append(row)
                     return
@@ -414,10 +419,11 @@ class MetricLogger:
         row.update(timings)
         row.update(extra)
         row.update(values)
-        if step < self._last_step:
-            logger.warning("Metrics row step went backwards (%d < %d)",
-                           step, self._last_step)
-        self._last_step = max(self._last_step, int(step))
+        with self._lock:
+            if step < self._last_step:
+                logger.warning("Metrics row step went backwards (%d < %d)",
+                               step, self._last_step)
+            self._last_step = max(self._last_step, int(step))
         self._write_row(row)
 
     def log_health(self, step: int, groups, **arrays: Any) -> None:
@@ -441,10 +447,19 @@ class MetricLogger:
                                "t0": round(float(t0), 6),
                                "dur_s": round(float(dur_s), 6)}
         if children:
-            row["children"] = [
-                {"name": c["name"], "t0": round(float(c["t0"]), 6),
-                 "dur_s": round(float(c["dur_s"]), 6)}
-                for c in children]
+            # clamp children inside the ROUNDED root: rounding t0/dur_s
+            # independently can push a child's end past the root's by up
+            # to ~1.5us, and consumers (Perfetto nesting, the span tests)
+            # rely on strict containment
+            root_t0 = row["t0"]
+            root_end = root_t0 + row["dur_s"]
+            kids = []
+            for c in children:
+                ct0 = min(max(round(float(c["t0"]), 6), root_t0), root_end)
+                cdur = max(min(round(float(c["dur_s"]), 6),
+                               root_end - ct0), 0.0)
+                kids.append({"name": c["name"], "t0": ct0, "dur_s": cdur})
+            row["children"] = kids
         row.update(fields)
         self._write_row(row)
 
@@ -461,13 +476,16 @@ class MetricLogger:
 
     def close(self) -> None:
         # a run that dies before its header still keeps its buffered rows:
-        # a headerless telemetry file beats a silently empty one
-        if self._pre_header:
-            with self._lock:
+        # a headerless telemetry file beats a silently empty one. The
+        # buffer check happens under the lock (two racing close() calls
+        # must not both claim the buffer); the flush itself re-enters
+        # _write_row, which the RLock permits.
+        with self._lock:
+            buffered, self._pre_header = self._pre_header, []
+            if buffered:
                 self._header_written = True
-                buffered, self._pre_header = self._pre_header, []
-            for b in buffered:
-                self._write_row(b)
+        for b in buffered:
+            self._write_row(b)
         with self._lock:
             if self._file is not None:
                 self._file.close()

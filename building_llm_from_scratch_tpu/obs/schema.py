@@ -1,0 +1,238 @@
+"""The telemetry schema registry: every event kind, span shape, and
+phase/segment table the JSONL sink may emit — declared ONCE, here.
+
+Before this module existed the schema lived in three places at once: the
+emitting call sites (``MetricLogger.event(...)`` kwargs scattered over a
+dozen modules), ``obs/trace.py``'s rendering tables, and a pinned fallback
+copy inside ``scripts/summarize_metrics.py``. PR 7's review caught exactly
+the failure mode that layout invites — a drift-prone private copy of
+``TICK_PHASES`` — so consumers now import from here and the GL04x
+telemetry lint (``analysis/telemetry.py``) checks every ``.event(...)``
+call site against this registry: adding a field or an event kind without
+declaring it is a lint failure, not a review catch.
+
+Stdlib-only and import-free (no jax, no numpy): the static analyzer, the
+renderer script and the trace exporter all load it without touching the
+accelerator stack.
+
+To register a new event kind:
+
+  1. add an ``EventSpec`` to ``EVENTS`` below (required fields are the
+     ones every emission must carry; ``open_fields=True`` admits dynamic
+     payloads like ``watchdog_halt``'s health context);
+  2. emit it with ``get_metrics().event("kind", ...)`` /
+     ``obs.metrics.emit_event`` — ``scripts/lint_graft.py`` verifies the
+     call site against the spec;
+  3. if the trace exporter should render it, add it to
+     ``INCIDENT_EVENTS`` / ``REQUEST_EVENTS`` (subsets of the registry —
+     test-asserted).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, FrozenSet, List
+
+#: Bump when a row type or a load-bearing field changes meaning. The
+#: ``header`` row carries it; consumers key parsing decisions on it.
+SCHEMA_VERSION = 3          # v3: + "span" row type (request/tick tracing)
+
+#: JSONL row discriminators (the ``type`` field).
+ROW_TYPES = ("header", "metrics", "health", "event", "span")
+
+#: Engine tick phases, in within-tick order (serving/engine.py accumulates
+#: wall-clock per phase and logs the sums at its metrics cadence as
+#: ``tick_<phase>_s`` fields; /metrics exports ``tick_<phase>_seconds``).
+TICK_PHASES = ("admit", "prefill", "decode_dispatch", "host_fetch",
+               "sample_commit", "callback_detok")
+
+#: Trainer StepTimeline segments (``<segment>_s`` fields of training
+#: cadence metrics rows; obs/timeline.py owns the measurement).
+TRAIN_SEGMENTS = ("data_wait", "dispatch", "host_fetch", "eval", "sample",
+                  "checkpoint")
+
+#: Event kinds rendered as instants on the trace's incidents track.
+INCIDENT_EVENTS = ("engine_restart", "drain", "serve_error", "stall",
+                   "watchdog_halt", "preemption_signal", "preemption_stop",
+                   "checkpoint_fallback", "serve_warmup")
+
+#: Request-lifecycle event kinds pinned to the request's own trace track.
+REQUEST_EVENTS = ("request_done", "request_rejected", "request_shed",
+                  "request_expired", "request_failed")
+
+#: Lifecycle event kinds that open the serving section of the renderer
+#: even when zero requests completed (incident runs).
+SERVING_LIFECYCLE_EVENTS = ("engine_restart", "drain", "serve_error")
+
+#: Root span names the ``span`` row type may carry (one tree per row).
+SPAN_NAMES = ("request",)
+
+#: Child span names under a ``request`` root, in lifecycle order.
+REQUEST_SPAN_PHASES = ("queued", "prefill", "decode")
+
+
+@dataclass(frozen=True)
+class EventSpec:
+    """Declared shape of one ``event`` row kind.
+
+    ``required``: every emission must carry these fields. ``optional``:
+    fields an emission may carry. ``open_fields``: the payload includes
+    dynamic keys (health context, stats dicts) — unknown fields are then
+    legal, but the declared ones still document the stable core.
+    """
+
+    name: str
+    required: FrozenSet[str] = frozenset()
+    optional: FrozenSet[str] = frozenset()
+    open_fields: bool = False
+    doc: str = ""
+
+    def known_fields(self) -> FrozenSet[str]:
+        return self.required | self.optional | ALWAYS_ALLOWED_FIELDS
+
+
+#: Fields every event row may carry regardless of kind (``event()`` adds
+#: ``step`` itself; ``type``/``time``/``event`` are the row envelope).
+ALWAYS_ALLOWED_FIELDS = frozenset({"step", "type", "time", "event"})
+
+
+def _spec(name: str, required=(), optional=(), open_fields=False,
+          doc: str = "") -> EventSpec:
+    return EventSpec(name, frozenset(required), frozenset(optional),
+                     open_fields, doc)
+
+
+_EVENT_LIST: List[EventSpec] = [
+    # -- run lifecycle ----------------------------------------------------
+    _spec("components_built",
+          optional=("model", "n_params", "est_train_mem_gb",
+                    "flops_per_token_analytic", "shard_mode",
+                    "load_weights", "prefetch", "async_ckpt",
+                    "tokenizer_cache"),
+          doc="model/optimizer/loader built; records the run's shape"),
+    _spec("run_complete", optional=("tokens_seen", "final_train_loss"),
+          doc="training main() reached its normal end"),
+    # -- fetch / retry ----------------------------------------------------
+    _spec("hf_fetch", required=("repo",),
+          optional=("files", "bytes", "cached", "seconds"),
+          doc="HF hub download (downloaded vs cached bytes split)"),
+    _spec("retry", required=("describe",),
+          optional=("error", "attempt", "attempts", "delay_s"),
+          doc="bounded-retry attempt (utils/retry.py)"),
+    _spec("tokenize_cache", required=("file", "source"),
+          optional=("tokens", "seconds"),
+          doc="TokenCache hit/encode (source: memory|disk|encoded)"),
+    # -- compile telemetry ------------------------------------------------
+    _spec("compile", required=("label",),
+          optional=("compile_seconds", "lower_seconds",
+                    "backend_compile_seconds", "executable_device_count",
+                    "flops", "flops_per_device", "transcendentals",
+                    "bytes_accessed", "memory", "n_compiles",
+                    "tokens_per_step", "hbm_capacity_bytes",
+                    "hbm_budget_frac", "cache_dir", "cache_entries",
+                    "cache_hit"),
+          doc="one AOT compile capture (obs/compile.py)"),
+    _spec("recompile", required=("label",),
+          optional=("n_recompiles", "n_changed_leaves", "diff"),
+          doc="argument-signature change after the legitimate set closed"),
+    _spec("compile_fallback", required=("label",), optional=("error",),
+          doc="AOT capture failed; telemetry fell back to plain jit"),
+    # -- checkpoints ------------------------------------------------------
+    _spec("checkpoint_save", required=("path",),
+          optional=("seconds", "bytes", "leaves", "writer"),
+          doc="one durable checkpoint commit (sync or async writer)"),
+    _spec("checkpoint_restore", required=("path",),
+          optional=("seconds", "leaves"),
+          doc="checkpoint loaded into the train state"),
+    _spec("checkpoint_fallback", required=("path", "reason"),
+          doc="--resume auto skipped an invalid checkpoint"),
+    _spec("checkpoint_gc", optional=("removed", "keep"),
+          doc="--keep_ckpts retention GC removed old checkpoints"),
+    _spec("ckpt_async_save", required=("path",),
+          optional=("snapshot_s", "write_s", "overlap_s"),
+          doc="async checkpoint: snapshot/write/overlap seconds"),
+    # -- resilience -------------------------------------------------------
+    _spec("preemption_signal", required=("signal",),
+          doc="SIGTERM/SIGINT observed; stop at next step boundary"),
+    _spec("preemption_stop", optional=("tokens_seen",),
+          doc="graceful stop checkpoint written at the step boundary"),
+    _spec("watchdog_halt", required=("reason",),
+          optional=("loss", "recent", "median", "spike_factor"),
+          open_fields=True,
+          doc="loss watchdog halt (+ dynamic per-layer health context)"),
+    _spec("stall", optional=("elapsed_s", "threshold_s", "memory"),
+          doc="flight recorder fired: stacks + device memory dumped"),
+    # -- serving: request lifecycle ---------------------------------------
+    _spec("request_done", required=("request_id",),
+          optional=("n_prompt_tokens", "n_tokens", "finish_reason", "slot",
+                    "deadline_s", "queue_wait_s", "ttft_s", "tpot_s",
+                    "e2e_s"),
+          doc="one request completed normally (latency summary)"),
+    _spec("request_rejected", required=("request_id", "reason"),
+          optional=("queue_depth",),
+          doc="bounded queue at capacity at submit (HTTP 429)"),
+    _spec("request_shed", required=("request_id", "reason"),
+          optional=("queue_depth", "deadline_s", "estimated_e2e_s",
+                    "retry_after_s"),
+          doc="SLO-predicted deadline miss rejected at submit"),
+    _spec("request_expired", required=("request_id", "reason"),
+          optional=("deadline_s", "queue_wait_s", "queue_depth"),
+          doc="deadline passed while queued (TTL shed, HTTP 504)"),
+    _spec("request_failed", required=("request_id", "reason"),
+          optional=("error", "slot", "n_tokens"),
+          doc="one request failed in isolation (or engine death/restart)"),
+    # -- serving: engine lifecycle ----------------------------------------
+    _spec("serve_warmup",
+          optional=("n_prefill_buckets", "buckets", "seconds", "n_slots",
+                    "max_len"),
+          doc="prefill buckets + decode program compiled; watchers frozen"),
+    _spec("serve_summary", open_fields=True,
+          doc="shutdown stats snapshot (histogram percentiles, counters)"),
+    _spec("serve_error", required=("error",),
+          optional=("n_failed", "failed_request_ids"),
+          doc="engine died; every in-flight/queued request failed"),
+    _spec("engine_restart", required=("reason",),
+          optional=("detail", "n_restart", "max_restarts", "backoff_s",
+                    "n_inflight_failed", "failed_request_ids",
+                    "queue_depth"),
+          doc="supervisor abandoned a wedged loop and restarted it"),
+    _spec("drain", required=("phase",),
+          optional=("timeout_s", "n_active", "queue_depth", "n_preempted",
+                    "seconds", "requests_finished"),
+          doc="graceful drain bracketing events (phase: start|end)"),
+]
+
+#: kind -> EventSpec. The single source of truth the GL04x lint, the
+#: renderer and the trace exporter consume.
+EVENTS: Dict[str, EventSpec] = {s.name: s for s in _EVENT_LIST}
+
+
+def validate_event(kind: str, fields: Dict[str, Any]) -> List[str]:
+    """Schema-check one event emission; returns a list of problems
+    (empty = conforming). Used by the analyzer's runtime twin and the
+    telemetry tests — emission itself stays unvalidated (a telemetry row
+    must never crash the run it observes)."""
+    spec = EVENTS.get(kind)
+    if spec is None:
+        return [f"unregistered event kind '{kind}'"]
+    problems = []
+    missing = spec.required - set(fields) - ALWAYS_ALLOWED_FIELDS
+    if missing:
+        problems.append(
+            f"event '{kind}' missing required field(s) "
+            f"{sorted(missing)}")
+    if not spec.open_fields:
+        unknown = set(fields) - spec.known_fields()
+        if unknown:
+            problems.append(
+                f"event '{kind}' carries undeclared field(s) "
+                f"{sorted(unknown)}")
+    return problems
+
+
+# sanity: the trace-exporter groups must be subsets of the registry —
+# an entry here that no emitter can produce is schema drift in the other
+# direction (also test-asserted so a failure names the stray entry)
+for _group in (INCIDENT_EVENTS, REQUEST_EVENTS, SERVING_LIFECYCLE_EVENTS):
+    for _name in _group:
+        assert _name in EVENTS, f"{_name} not in the event registry"

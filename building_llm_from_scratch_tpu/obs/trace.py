@@ -43,23 +43,16 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, List, Optional
 
-#: Engine tick phases, in within-tick order (serving/engine.py accumulates
-#: wall-clock per phase and logs the sums at its metrics cadence).
-TICK_PHASES = ("admit", "prefill", "decode_dispatch", "host_fetch",
-               "sample_commit", "callback_detok")
-
-#: Trainer StepTimeline segments rendered as train-track slices.
-TRAIN_SEGMENTS = ("data_wait", "dispatch", "host_fetch", "eval", "sample",
-                  "checkpoint")
-
-#: Event kinds rendered as instants on the incidents track.
-INCIDENT_EVENTS = ("engine_restart", "drain", "serve_error", "stall",
-                   "watchdog_halt", "preemption_signal", "preemption_stop",
-                   "checkpoint_fallback", "serve_warmup")
-
-#: Request-lifecycle event kinds pinned to the request's own track.
-REQUEST_EVENTS = ("request_done", "request_rejected", "request_shed",
-                  "request_expired", "request_failed")
+# the phase/segment/event tables live in the ONE schema registry
+# (obs/schema.py) — this module used to own private copies, which is the
+# drift class graft-lint GL044 now forbids. Re-exported here because the
+# engine and tests historically import TICK_PHASES from obs.trace.
+from building_llm_from_scratch_tpu.obs.schema import (  # noqa: F401
+    INCIDENT_EVENTS,
+    REQUEST_EVENTS,
+    TICK_PHASES,
+    TRAIN_SEGMENTS,
+)
 
 _PID_REQUESTS, _PID_ENGINE, _PID_TRAIN, _PID_INCIDENTS = 1, 2, 3, 4
 

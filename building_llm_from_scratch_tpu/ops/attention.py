@@ -57,7 +57,6 @@ TPU-first details shared by all paths:
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax

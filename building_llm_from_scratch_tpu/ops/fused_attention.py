@@ -29,7 +29,6 @@ repeated KV heads — the kv BlockSpec index_map divides the head index.
 from __future__ import annotations
 
 import functools
-from typing import Tuple
 
 import jax
 import jax.numpy as jnp

@@ -73,7 +73,7 @@ from building_llm_from_scratch_tpu.obs.metrics import (
     get_metrics,
     render_prometheus,
 )
-from building_llm_from_scratch_tpu.obs.trace import TICK_PHASES
+from building_llm_from_scratch_tpu.obs.schema import TICK_PHASES
 from building_llm_from_scratch_tpu.serving.queue import (
     EngineDrainingError,
     QueueFullError,
@@ -150,7 +150,8 @@ class DecodeEngine:
 
         self.queue = RequestQueue(max_queue)
         self.scheduler = Scheduler(self.n_slots)
-        self.cache = init_slot_cache(cfg, self.n_slots, self.max_len)
+        self.cache = init_slot_cache(
+            cfg, self.n_slots, self.max_len)            # guarded-by: _lock
         self._blocks = unstack_blocks(params, cfg)
 
         S = self.n_slots
@@ -158,12 +159,13 @@ class DecodeEngine:
         # PRNG key width depends on the configured impl (threefry (2,),
         # rbg (4,)) — probe it instead of assuming
         probe_key = np.asarray(_prng_key(0))
-        self._lengths = np.zeros((S,), np.int32)
-        self._last_tokens = np.zeros((S,), np.int32)
-        self._n_gen = np.zeros((S,), np.int32)
-        self._base_keys = np.zeros((S,) + probe_key.shape, probe_key.dtype)
-        self._temps = np.zeros((S,), np.float32)
-        self._topks = np.zeros((S,), np.int32)
+        self._lengths = np.zeros((S,), np.int32)        # guarded-by: _lock
+        self._last_tokens = np.zeros((S,), np.int32)    # guarded-by: _lock
+        self._n_gen = np.zeros((S,), np.int32)          # guarded-by: _lock
+        self._base_keys = np.zeros(
+            (S,) + probe_key.shape, probe_key.dtype)    # guarded-by: _lock
+        self._temps = np.zeros((S,), np.float32)        # guarded-by: _lock
+        self._topks = np.zeros((S,), np.int32)          # guarded-by: _lock
 
         # donate the cache panes: the caller always rebinds self.cache to
         # the outputs, so XLA may alias input->output and the pallas
@@ -184,52 +186,59 @@ class DecodeEngine:
         self._work = threading.Condition()
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
-        self._dead: Optional[str] = None        # set by _fail_all
-        self._draining = False                  # set by drain()
+        # _dead/_draining: written by _fail_all/drain; racy READS are the
+        # design (submit's fast-path check repeats its decision under a
+        # real barrier), so only writes are lock-checked
+        self._dead: Optional[str] = None    # guarded-by: _lock [writes]
+        self._draining = False              # guarded-by: _lock [writes]
         # bumped on every supervisor restart; a stale loop thread (one
         # that eventually un-wedges after being abandoned) sees the bump
-        # and exits WITHOUT committing any state (see step())
-        self._generation = 0
+        # and exits WITHOUT committing any state (see step()). Reads are
+        # deliberately lock-free generation checks — a stale read only
+        # delays the abandonment by one commit point.
         self._restart_lock = threading.Lock()
-        self.n_restarts = 0
+        self._generation = 0        # guarded-by: _restart_lock [writes]
+        self.n_restarts = 0         # guarded-by: _restart_lock [writes]
         self.warmed_up = False
         # live service-time estimate for SLO-aware admission: EWMAs of
         # per-token decode time and tokens-per-request over finished
         # requests (alpha 0.2 — a few requests of history dominate)
-        self._tpot_ewma: Optional[float] = None
-        self._tokens_ewma: Optional[float] = None
+        self._tpot_ewma: Optional[float] = None     # guarded-by: _lock
+        self._tokens_ewma: Optional[float] = None   # guarded-by: _lock
 
         # rolling serve accounting: fixed-bucket histograms (obs/metrics
         # Histogram — Prometheus semantics, O(buckets) memory forever;
         # replaces the 8192-deque reservoirs whose percentiles silently
         # covered only the most recent window of a long-running server)
         # plus a rolling deadline-miss ratio for SLO burn-rate alerting
-        self.n_ticks = 0
-        self.tokens_generated = 0
-        self.requests_finished = 0
-        self.requests_rejected = 0
-        self.requests_failed = 0
-        self.requests_shed = 0
-        self.requests_expired = 0
+        self.n_ticks = 0                    # guarded-by: _lock
+        self.tokens_generated = 0           # guarded-by: _lock
+        self.requests_finished = 0          # guarded-by: _lock
+        self.requests_rejected = 0          # guarded-by: _lock
+        self.requests_failed = 0            # guarded-by: _lock
+        self.requests_shed = 0              # guarded-by: _lock
+        self.requests_expired = 0           # guarded-by: _lock
         self.ttft_hist = Histogram()
         self.tpot_hist = Histogram()
         self.queue_wait_hist = Histogram()
         self.e2e_hist = Histogram()
         self.slo_window = RollingRatio(window_s=300.0)
         self._t_start_mono = time.monotonic()
-        self._window_tokens = 0
-        self._window_t0 = time.monotonic()
+        self._window_tokens = 0             # guarded-by: _lock
+        self._window_t0 = time.monotonic()  # guarded-by: _lock
         # per-tick phase breakdown (obs/trace.TICK_PHASES): wall-clock
         # accumulated with perf_counter ONLY — the instrumentation adds
         # zero device fetches (guard-tested). `_tick_acc` is the current
         # metrics window (reset at cadence, logged into the metrics row);
         # `tick_phase_totals` is cumulative for the /metrics counters.
-        self._tick_acc = {ph: 0.0 for ph in TICK_PHASES}
-        self._tick_acc_total = 0.0
-        self.tick_phase_totals = {ph: 0.0 for ph in TICK_PHASES}
-        self.tick_seconds_total = 0.0
-        self._window_ticks = 0
-        self._win_t0_wall = time.time()
+        self._tick_acc = {ph: 0.0
+                          for ph in TICK_PHASES}         # guarded-by: _lock
+        self._tick_acc_total = 0.0                       # guarded-by: _lock
+        self.tick_phase_totals = {ph: 0.0
+                                  for ph in TICK_PHASES}  # guarded-by: _lock
+        self.tick_seconds_total = 0.0                    # guarded-by: _lock
+        self._window_ticks = 0                           # guarded-by: _lock
+        self._win_t0_wall = time.time()                  # guarded-by: _lock
 
     # -- jitted programs (close over params/cfg/blocks so per-tick call
     # signatures carry only the small mutable state + caches) -------------
@@ -322,9 +331,25 @@ class DecodeEngine:
         if self._dead is not None:
             raise RuntimeError(f"engine is dead: {self._dead}")
         if self._draining:
+            # the backlog estimate reads the service EWMAs, which mutate
+            # under the engine lock (GL031). TIMED acquire: drain() sets
+            # _draining at entry but only replaces a wedged lock after
+            # its timeout wait, so an unbounded acquire here could park
+            # the client's thread forever on the abandoned lock — on
+            # timeout, skip the estimate (Retry-After is best-effort)
+            # rather than delay the 503
+            lock = self._lock
+            retry = None
+            locked = lock.acquire(timeout=0.5)
+            try:
+                if locked:
+                    retry = self.estimate_queue_clear_s()
+            finally:
+                if locked:
+                    lock.release()
             raise EngineDrainingError(
                 "engine is draining: admission closed",
-                retry_after_s=self.estimate_queue_clear_s())
+                retry_after_s=retry)
         params = params or SamplingParams()
         if params.deadline_s is None and self.default_deadline_s:
             import dataclasses
@@ -357,14 +382,32 @@ class DecodeEngine:
             # / n_slots) x EWMA per-request service time + the request's
             # own decode budget x TPOT. Predictably blowing the deadline
             # gets a useful 429 NOW instead of a useless 504 later.
-            est = self.estimate_completion_s(len(self.queue),
-                                             params.max_new_tokens)
-            if est is not None and est > params.deadline_s:
-                with self._lock:
-                    self.requests_shed += 1
+            # The whole decision runs under the engine lock: the EWMAs
+            # and the shed counter mutate under it, and the pre-fix
+            # lock-free reads were exactly the unguarded-EWMA access
+            # class graft-lint GL031 now flags. TIMED acquire: a wedged
+            # tick may hold this lock forever (and drain/restart later
+            # abandon it, not release it) — a submit racing the wedge
+            # window must stay bounded, so on timeout the shed check is
+            # skipped and the request admitted optimistically (the queue
+            # TTL expiry still protects its deadline downstream).
+            lock = self._lock
+            shed = False
+            locked = lock.acquire(timeout=1.0)
+            try:
+                if locked:
+                    est = self.estimate_completion_s(
+                        len(self.queue), params.max_new_tokens)
+                    shed = est is not None and est > params.deadline_s
+                    if shed:
+                        self.requests_shed += 1
+                        retry = round(max(self.estimate_queue_clear_s()
+                                          or 0.0, 0.001), 3)
+            finally:
+                if locked:
+                    lock.release()
+            if shed:
                 self.slo_window.observe(miss=True)
-                retry = round(max(self.estimate_queue_clear_s() or 0.0,
-                                  0.001), 3)
                 req.error = (f"shed at submit: estimated completion "
                              f"{est:.2f}s > deadline {params.deadline_s}s")
                 req.finish_reason = FINISH_SHED
@@ -426,6 +469,7 @@ class DecodeEngine:
 
     # -- SLO service estimate ---------------------------------------------
 
+    # holds: _lock
     def estimate_completion_s(self, queue_depth: int,
                               max_new_tokens: int) -> Optional[float]:
         """Predicted submit->finish seconds for a request entering the
@@ -443,6 +487,7 @@ class DecodeEngine:
         wait = (backlog / max(self.n_slots, 1)) * per_request
         return wait + max_new_tokens * self._tpot_ewma
 
+    # holds: _lock
     def estimate_queue_clear_s(self) -> Optional[float]:
         """Rough seconds until the current backlog drains (Retry-After
         material for 429/503 responses)."""
@@ -452,6 +497,7 @@ class DecodeEngine:
         backlog = len(self.queue) + self.scheduler.n_active
         return round((backlog / max(self.n_slots, 1)) * per_request, 3)
 
+    # holds: _lock
     def _observe_service_time(self, req: Request) -> None:
         """Fold one finished request into the TPOT/length EWMAs (only
         normal completions: failed/expired requests have no useful
@@ -470,6 +516,7 @@ class DecodeEngine:
 
     # -- admission-boundary shed ------------------------------------------
 
+    # holds: _lock
     def _admission_skip(self, req: Request) -> bool:
         """Scheduler skip hook: shed expired/cancelled requests the moment
         they reach the queue head, without consuming a slot."""
@@ -496,6 +543,7 @@ class DecodeEngine:
             return True
         return False
 
+    # holds: _lock
     def _admit(self, slot: int, req: Request, gen: int) -> None:
         """Prefill one admitted request into ``slot``. Fault-isolated: a
         host-side fault on THIS request's path (injected prefill fault,
@@ -508,11 +556,16 @@ class DecodeEngine:
         is a wedge point the supervisor may abandon, so a thread that
         un-wedges here must re-check before committing the new cache —
         otherwise it would overwrite the restarted engine's fresh KV."""
-        Tp = int(req.prompt_ids.size)
+        import jax
+
+        Tp = int(req.prompt_ids.size)   # graft-ok: GL011 host numpy size
         Tpb = self._bucket_len(Tp)
         padded = np.zeros((1, Tpb), np.int32)
         padded[0, :Tp] = req.prompt_ids
-        base_key = np.asarray(_prng_key(req.params.seed))
+        # explicit device_get: the ONLY sanctioned d->h idiom in the tick
+        # path — the transfer-guard sentry (analysis/runtime.py) lets it
+        # through while failing any implicit fetch that sneaks in
+        base_key = jax.device_get(_prng_key(req.params.seed))
         temp = np.float32(req.params.temperature)
         topk = np.int32(req.params.top_k or 0)
         try:
@@ -544,15 +597,17 @@ class DecodeEngine:
         self._topks[slot] = topk
         if self.hooks.poison_nan(req):
             self._poison_slot_cache(slot)      # fault injection (tests)
-        ok_host = bool(ok)                     # blocks until prefill ran
+        # explicit fetch; blocks until prefill ran
+        ok_host = bool(jax.device_get(ok))
         self._tick_add("prefill", time.perf_counter() - t_pf)
         if not ok_host:
             self._fail_request(slot, req,
                                "non-finite logits in prefill",
                                reason="non_finite_logits")
             return
-        self._accept_token(slot, req, int(tok), gen)
+        self._accept_token(slot, req, int(jax.device_get(tok)), gen)
 
+    # holds: _lock
     def _poison_slot_cache(self, slot: int) -> None:
         """Overwrite one slot's KV rows with NaN (fault-injection hook):
         the next decode tick's logits for that row go non-finite IN-GRAPH,
@@ -577,6 +632,7 @@ class DecodeEngine:
         Every terminal transition calls this exactly once."""
         get_metrics().log_span(**req.trace_row())
 
+    # holds: _lock
     def _tick_add(self, phase: str, dt: float) -> None:
         """Accumulate wall-clock into one tick phase: the current metrics
         window (drained into the cadence row) and the cumulative totals
@@ -585,6 +641,7 @@ class DecodeEngine:
         self._tick_acc[phase] += dt
         self.tick_phase_totals[phase] += dt
 
+    # holds: _lock
     def _book_tick_wall(self, t0: float) -> None:
         """Add a tick's elapsed wall time to the window/cumulative
         totals. Called on EVERY exit from the timed part of ``step()`` —
@@ -606,6 +663,8 @@ class DecodeEngine:
         replaces the lock, so a tick that un-wedges AFTER the supervisor
         abandoned it discovers the bump at the next checkpoint and returns
         without committing any state into the restarted engine."""
+        import jax
+
         gen = self._generation
         lock = self._lock
         with lock:
@@ -666,13 +725,16 @@ class DecodeEngine:
                 self._book_tick_wall(t_tick0)
                 return False
             # `host_fetch` covers the donated-cache rebind AND the two
-            # device->host conversions: dropping the old (donated-away)
-            # cache arrays and np.asarray both block on the in-flight
-            # step, so this phase is "waiting for the device to catch up"
+            # device->host fetches: dropping the old (donated-away)
+            # cache arrays and the device_get both block on the in-flight
+            # step, so this phase is "waiting for the device to catch up".
+            # EXPLICIT device_get, never np.asarray/float(): these are
+            # the tick's only two sanctioned d->h transfers, and the
+            # transfer-guard sentry test proves nothing implicit remains
             t_fetch = time.perf_counter()
             self.cache = {"k": k, "v": v}
-            nxt = np.asarray(nxt)
-            ok_rows = np.asarray(ok)
+            nxt = jax.device_get(nxt)
+            ok_rows = jax.device_get(ok)
             self._tick_add("host_fetch", time.perf_counter() - t_fetch)
             cb0 = self._tick_acc["callback_detok"]
             t_commit = time.perf_counter()
@@ -705,6 +767,7 @@ class DecodeEngine:
         while self.step():
             pass
 
+    # holds: _lock
     def _accept_token(self, slot: int, req: Request, tok: int,
                       gen: int) -> None:
         eos = resolve_eos(req.params, self.cfg.eos_id)
@@ -775,6 +838,7 @@ class DecodeEngine:
         req._detok_start = len(req.output_ids)
         return tail
 
+    # holds: _lock
     def _free_slot(self, slot: int) -> None:
         self.scheduler.retire(slot)
         self._lengths[slot] = 0
@@ -783,6 +847,7 @@ class DecodeEngine:
         self._temps[slot] = 0.0
         self._topks[slot] = 0
 
+    # holds: _lock
     def _fail_request(self, slot: Optional[int], req: Request, msg: str,
                       reason: str, finish: str = FINISH_ERROR) -> None:
         """Fail ONE request (fault isolation): free its slot if it holds
@@ -811,6 +876,7 @@ class DecodeEngine:
         with self._work:
             self._work.notify_all()
 
+    # holds: _lock
     def _finish(self, slot: int, req: Request, reason: str) -> None:
         tail = self._detok_piece(req, final=True)  # flush any held bytes
         if tail:
@@ -842,6 +908,7 @@ class DecodeEngine:
         with self._work:
             self._work.notify_all()
 
+    # holds: _lock
     def _maybe_log_metrics(self) -> None:
         if self.metrics_every <= 0 or self.n_ticks % self.metrics_every:
             return
@@ -884,34 +951,40 @@ class DecodeEngine:
         prompt bucket + THE decode step — then freeze the watchers so any
         later signature is reported as a bucket-miss ``recompile``. The
         warmup traffic runs through slot 0 with throwaway state; host
-        state is reset after."""
+        state is reset after. Runs under the engine lock: warmup normally
+        precedes ``start()``, but holding the lock makes a late warmup
+        (or a concurrent early submit) safe instead of silently corrupting
+        slot state."""
+        import jax
+
         t0 = time.monotonic()
-        buckets = self.prompt_buckets()
-        zero_key = np.zeros_like(self._base_keys[0])
-        for Tpb in buckets:
-            dummy = np.zeros((1, Tpb), np.int32)
-            tok, _ok, k, v = self._prefill(
-                self.cache["k"], self.cache["v"], dummy, np.int32(1),
-                np.int32(0), zero_key, np.float32(0.0), np.int32(0))
+        with self._lock:
+            buckets = self.prompt_buckets()
+            zero_key = np.zeros_like(self._base_keys[0])
+            for Tpb in buckets:
+                dummy = np.zeros((1, Tpb), np.int32)
+                tok, _ok, k, v = self._prefill(
+                    self.cache["k"], self.cache["v"], dummy, np.int32(1),
+                    np.int32(0), zero_key, np.float32(0.0), np.int32(0))
+                self.cache = {"k": k, "v": v}
+            nxt, _ok, k, v = self._decode(
+                self.cache["k"], self.cache["v"], self._last_tokens,
+                self._lengths, self._base_keys, self._n_gen, self._temps,
+                self._topks)
             self.cache = {"k": k, "v": v}
-        nxt, _ok, k, v = self._decode(
-            self.cache["k"], self.cache["v"], self._last_tokens,
-            self._lengths, self._base_keys, self._n_gen, self._temps,
-            self._topks)
-        self.cache = {"k": k, "v": v}
-        np.asarray(nxt)                       # block until compiled + ran
-        if isinstance(self._prefill, CompileWatcher):
-            self._prefill.freeze()
-            self._decode.freeze()
-        self._lengths[:] = 0
-        self._last_tokens[:] = 0
-        self._n_gen[:] = 0
-        # re-anchor the metrics window: the first cadence row should
-        # describe serving, not a window stretched over compile time
-        self._window_t0 = time.monotonic()
-        self._win_t0_wall = time.time()
-        self._window_tokens = 0
-        self.warmed_up = True
+            jax.device_get(nxt)               # block until compiled + ran
+            if isinstance(self._prefill, CompileWatcher):
+                self._prefill.freeze()
+                self._decode.freeze()
+            self._lengths[:] = 0
+            self._last_tokens[:] = 0
+            self._n_gen[:] = 0
+            # re-anchor the metrics window: the first cadence row should
+            # describe serving, not a window stretched over compile time
+            self._window_t0 = time.monotonic()
+            self._win_t0_wall = time.time()
+            self._window_tokens = 0
+            self.warmed_up = True
         get_metrics().event(
             "serve_warmup", n_prefill_buckets=len(buckets),
             buckets=buckets, seconds=round(time.monotonic() - t0, 3),
@@ -1051,7 +1124,10 @@ class DecodeEngine:
         locked = lock.acquire(timeout=5.0)
         try:
             if not locked:
-                with self._restart_lock:
+                # edge is infeasible: this branch runs only when the
+                # _lock acquire FAILED (wedged tick), and _restart
+                # acquires the REPLACEMENT lock, not the abandoned one
+                with self._restart_lock:  # graft-ok: GL032 wedge path
                     self._generation += 1   # wedged loop may never commit
                     self._lock = threading.RLock()   # see drain(): later
                     self._work = threading.Condition()  # paths must not
@@ -1111,8 +1187,25 @@ class DecodeEngine:
             return False
         req._cancelled = True
         if req.state == QUEUED and self.queue.remove(req):
-            self._fail_request(None, req, "cancelled while queued",
-                               reason="cancelled", finish=FINISH_CANCELLED)
+            # under the engine lock: _fail_request mutates the shared
+            # failure counters and must not interleave with a tick
+            # retiring the same request (pre-fix this ran lock-free from
+            # client threads — a real GL031 finding). TIMED acquire: a
+            # wedged tick holds the lock forever and restart ABANDONS
+            # (never releases) it, so an unbounded acquire would leak
+            # this client thread — on timeout fall back to the old
+            # lock-free retire: we already own the request (remove()
+            # returned True) and the wedged tick can never commit it
+            # (generation-checked), so the race window is gone with it
+            lock = self._lock
+            locked = lock.acquire(timeout=2.0)
+            try:
+                self._fail_request(None, req, "cancelled while queued",
+                                   reason="cancelled",
+                                   finish=FINISH_CANCELLED)
+            finally:
+                if locked:
+                    lock.release()
         with self._work:
             self._work.notify()
         return True
@@ -1126,7 +1219,10 @@ class DecodeEngine:
         small summary dict (also emitted as the ``drain`` event)."""
         t0 = time.monotonic()
         already = self._draining
-        self._draining = True
+        # deliberately lock-free write: drain's whole reason to exist is
+        # the wedged-tick case where self._lock may NEVER be released —
+        # a bool store is atomic and readers re-check under real barriers
+        self._draining = True                  # graft-ok: GL031 wedge-safe
         if not already:
             get_metrics().event(
                 "drain", phase="start", timeout_s=timeout,
@@ -1164,7 +1260,10 @@ class DecodeEngine:
                     "Drain: decode tick wedged (lock held > %.1fs); "
                     "abandoning it and force-failing in-flight requests.",
                     lock_wait)
-                with self._restart_lock:
+                # edge is infeasible: this branch runs only when the
+                # _lock acquire FAILED (wedged tick), and _restart
+                # acquires the REPLACEMENT lock, not the abandoned one
+                with self._restart_lock:  # graft-ok: GL032 wedge path
                     self._generation += 1
                     # the wedged thread holds the OLD lock forever — give
                     # every later path (shutdown's stats(), submit's

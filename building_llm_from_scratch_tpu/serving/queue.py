@@ -49,9 +49,13 @@ class RequestQueue:
         if max_size < 1:
             raise ValueError("max_size must be >= 1")
         self.max_size = max_size
-        self._q: "collections.deque[Request]" = collections.deque()
         self._lock = threading.Lock()
+        # the condvar WRAPS _lock, so `with self._not_full:` and
+        # `with self._lock:` acquire the same mutex (graft-lint GL03x
+        # understands the alias)
         self._not_full = threading.Condition(self._lock)
+        self._q: "collections.deque[Request]" = (
+            collections.deque())                        # guarded-by: _lock
 
     def __len__(self) -> int:
         with self._lock:

@@ -92,7 +92,12 @@ class EngineSupervisor:
         self.tick_timeout_s = float(tick_timeout_s)
         self.max_restarts = int(max_restarts)
         self.backoff_s = float(backoff_s)
+        # serializes concurrent stall callbacks (detector thread vs a
+        # re-armed fire landing mid-recovery); in the cross-module lock
+        # graph (graft-lint GL032) this lock sits ABOVE the engine's
+        # _restart_lock/_lock — never acquire it from engine code
         self._lock = threading.Lock()
+        self.n_fires = 0                         # guarded-by: _lock
         self.detector = StallDetector(
             timeout=self.tick_timeout_s,
             median_floor=self.tick_timeout_s,
@@ -111,9 +116,11 @@ class EngineSupervisor:
         # the detector already dumped the flight record (stacks + device
         # memory + a `stall` event); what remains is the recovery action
         with self._lock:
+            self.n_fires += 1
             logger.error(
                 "Serving tick hung for %.1fs (threshold %.1fs): "
-                "restarting the decode loop.", elapsed, threshold)
+                "restarting the decode loop (watchdog fire %d).",
+                elapsed, threshold, self.n_fires)
             if not self.engine._restart(
                     reason="hung_tick",
                     detail=f"tick made no progress for {elapsed:.1f}s"):

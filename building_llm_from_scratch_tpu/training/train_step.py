@@ -41,7 +41,6 @@ from building_llm_from_scratch_tpu.configs import ModelConfig
 from building_llm_from_scratch_tpu.models.lora import merge_lora
 from building_llm_from_scratch_tpu.obs.health import group_health
 from building_llm_from_scratch_tpu.models.transformer import (
-    forward,
     forward_hidden,
 )
 from building_llm_from_scratch_tpu.ops.softmax_xent import (

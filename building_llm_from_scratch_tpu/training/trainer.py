@@ -470,15 +470,16 @@ class Trainer:
                                         name="eval-prefetch")
             try:
                 for batch, _n_tok in pf:
-                    losses.append(float(self.eval_step(self.state, batch)))
+                    losses.append(float(jax.device_get(
+                        self.eval_step(self.state, batch))))
             finally:
                 pf.close()
             return float(np.mean(losses)) if losses else float("nan")
         for i, arrays in enumerate(batches):
             if num_batches is not None and i >= num_batches:
                 break
-            losses.append(float(self.eval_step(self.state,
-                                               self._device_batch(arrays))))
+            losses.append(float(jax.device_get(
+                self.eval_step(self.state, self._device_batch(arrays)))))
         return float(np.mean(losses)) if losses else float("nan")
 
     def evaluate_model(self, train_batches, val_batches):
@@ -679,6 +680,7 @@ class Trainer:
                 batch, n_tok = item
             else:
                 batch = self._device_batch(item)
+                # graft-ok: GL011 host batch-shape metadata, no device sync
                 n_tok = int(np.prod(item[0].shape))
             with self.timeline.step_span(self.global_step + 1):
                 self.state, metrics = self.train_step(self.state, batch)
@@ -767,6 +769,7 @@ class Trainer:
                     "data_wait_s": round(window.get("data_wait", 0.0), 6),
                     "dispatch_s": round(window.get("dispatch", 0.0), 6),
                     "host_fetch_s": round(window.get("host_fetch", 0.0), 6),
+                    # graft-ok: GL011 host timeline dict, cadence boundary
                     "steps_in_window": int(window.get("steps", 0)),
                 }
                 stall_delta = 0
@@ -794,7 +797,9 @@ class Trainer:
                     # group-norms-compose identity is test-asserted) — no
                     # extra device fetch
                     for key in ("grad_norm", "update_norm"):
+                        # graft-ok: GL011, GL012 already-fetched host bundle
                         row[key] = round(float(np.sqrt(np.sum(
+                            # graft-ok: GL012 host bundle (see above)
                             np.asarray(self._last_health[key],
                                        np.float64) ** 2))), 8)
                 dev_mem = device_memory_stats()
@@ -883,10 +888,15 @@ class Trainer:
         collective-rendezvous surface that CHECK-aborts (SIGABRT) under
         thread contention, which is how `pytest tests/test_sharding.py`
         could die order-dependently in its zero1 Trainer test (round-4
-        VERDICT weak #1). Host-side reads have no such surface."""
+        VERDICT weak #1). Host-side reads have no such surface.
+
+        All fetches here are EXPLICIT ``jax.device_get``: this is the
+        sanctioned cadence-time fetch point, and the transfer-guard
+        sentry (analysis/runtime.py) proves the off-cadence step loop
+        performs no implicit device->host transfer at all."""
         if self._pending_lrs:
             self.track_lrs.extend(
-                float(np.asarray(lr)) for lr in self._pending_lrs)
+                float(v) for v in jax.device_get(self._pending_lrs))
             self._pending_lrs.clear()
         if self._pending_health:
             pending, self._pending_health = self._pending_health, []
@@ -895,11 +905,11 @@ class Trainer:
             # the watchdog context name the layer AT THE HALT STEP, not
             # whatever step happened to be last in the window
             self._health_by_step = {
-                step: {k: np.asarray(v) for k, v in h.items()}
-                for step, h in pending}
+                step: jax.device_get(h) for step, h in pending}
             self._last_health = self._health_by_step[pending[-1][0]]
         if self._pending_losses:
-            fetched = [float(np.asarray(x)) for x in self._pending_losses]
+            fetched = [float(v)
+                       for v in jax.device_get(self._pending_losses)]
             self._pending_losses.clear()
             if self.watchdog is not None and check_watchdog:
                 # base step of the oldest pending loss, so the diagnostic

@@ -11,7 +11,6 @@ corpus for the convergence runs recorded in RESULTS.md.
 
 import os
 import sys
-import tempfile
 
 from building_llm_from_scratch_tpu.datasets.gutenberg import (
     is_english,

@@ -10,15 +10,19 @@ cd "$(dirname "$0")/.."
 
 if command -v ruff >/dev/null 2>&1; then
     echo "== ruff check =="
+    # full pyflakes over the whole package now lives in pyproject's
+    # [tool.ruff.lint] select — one invocation, no scoped second pass
     ruff check . || exit 1
-    # obs/ + scripts are held to the full pyflakes ruleset (see the
-    # [tool.ruff.lint] comment in pyproject.toml: ruff has no per-file
-    # `select`, so the widened scope is this second invocation)
-    ruff check --extend-select F building_llm_from_scratch_tpu/obs \
-        building_llm_from_scratch_tpu/serving scripts || exit 1
 else
     echo "== ruff not installed; skipping lint =="
 fi
+
+echo "== graft-lint (static invariant analysis) =="
+# GL01x host-sync / GL02x jit-purity / GL03x lock-discipline / GL04x
+# telemetry-schema checkers vs analysis/baseline.json: any NON-BASELINED
+# finding fails the gate before the test suite spins up. The runner
+# prints per-rule counts, so two gate logs diff cleanly.
+python scripts/lint_graft.py || exit 1
 
 echo "== import smoke =="
 JAX_PLATFORMS=cpu python -c "

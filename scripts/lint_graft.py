@@ -1,0 +1,22 @@
+#!/usr/bin/env python
+"""graft-lint CLI shim: static invariant analysis for this repo.
+
+    python scripts/lint_graft.py                 # scan vs baseline
+    python scripts/lint_graft.py --rules         # rule catalog
+    python scripts/lint_graft.py --update-baseline
+    python scripts/lint_graft.py --json -        # machine-readable
+
+Equivalent to ``python -m building_llm_from_scratch_tpu.analysis``; see
+``building_llm_from_scratch_tpu/analysis/`` for the checkers and
+``analysis/baseline.json`` for the accepted-debt ledger.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from building_llm_from_scratch_tpu.analysis.runner import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

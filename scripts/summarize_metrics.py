@@ -24,14 +24,15 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-try:
-    # canonical phase list (obs/trace.py — what the engine actually logs);
-    # the renderer itself stays dependency-free, so a missing/broken
-    # package install falls back to a pinned copy instead of crashing
-    from building_llm_from_scratch_tpu.obs.trace import TICK_PHASES
-except Exception:                                      # pragma: no cover
-    TICK_PHASES = ("admit", "prefill", "decode_dispatch", "host_fetch",
-                   "sample_commit", "callback_detok")
+# the canonical schema registry (obs/schema.py): phase tables + event
+# groups — no pinned fallback copy (the private-copy pattern is exactly
+# the drift hazard graft-lint GL044 forbids). Loaded by FILE PATH so the
+# renderer stays dependency-free: a package import of obs.schema would
+# run obs/__init__ and hard-require jax, the exact breakage the old
+# fallback existed to absorb.
+from building_llm_from_scratch_tpu.analysis.base import load_schema_module
+
+SCHEMA = load_schema_module()
 
 
 def load_rows(path):
@@ -168,7 +169,7 @@ def summarize_serving(metrics, events):
     # those are exactly the files this section must explain, so lifecycle
     # events open the section too, not just request-level ones
     lifecycle = [e for e in events
-                 if e["event"] in ("engine_restart", "drain", "serve_error")]
+                 if e["event"] in SCHEMA.SERVING_LIFECYCLE_EVENTS]
     if not (done or rejected or failed or shed or expired or lifecycle):
         return
     print("\n-- serving --")
@@ -226,7 +227,7 @@ def summarize_ticks(metrics, events):
         print("  tick breakdown (per-tick, over "
               f"{int(sum(r['ticks_in_window'] for r in rows))} ticks):")
         sums = {}
-        for ph in TICK_PHASES:
+        for ph in SCHEMA.TICK_PHASES:
             per_tick = [r[f"tick_{ph}_s"] / r["ticks_in_window"]
                         for r in rows
                         if isinstance(r.get(f"tick_{ph}_s"), (int, float))]

@@ -1,0 +1,44 @@
+"""A clean fixture: hot path, jitted function, guarded fields and event
+emissions all conforming — the analyzers must report ZERO findings here.
+"""
+
+import threading
+
+import jax
+
+from building_llm_from_scratch_tpu.obs.metrics import emit_event
+
+
+# graft: hot-path
+def hot_loop(stream):
+    total = 0.0
+    for step_out in stream:
+        host = jax.device_get(step_out)     # explicit: sanctioned
+        total += float(host)                # host-typed via device_get
+    return total
+
+
+def pure_step(state, batch):
+    return state + batch.sum()
+
+
+jitted = jax.jit(pure_step)
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.hits = 0                        # guarded-by: _lock
+
+    def bump(self):
+        with self._lock:
+            self.hits += 1
+
+    def snapshot(self):
+        lock = self._lock
+        with lock:                           # alias resolution
+            return self.hits
+
+
+def emit(step):
+    emit_event("checkpoint_save", path="/tmp/x", seconds=0.5, step=step)

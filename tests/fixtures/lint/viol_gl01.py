@@ -1,0 +1,25 @@
+"""Seeded GL01x violations: implicit device->host syncs in a hot path.
+
+NOT importable production code — a fixture the analyzer tests run the
+checkers over. Line positions matter to the tests; edit with care.
+"""
+
+import numpy as np
+
+
+# graft: hot-path
+def hot_loop(stream, device_value):
+    total = 0.0
+    for step_out in stream:
+        total += float(step_out)            # line 14: GL011
+        arr = np.asarray(device_value)      # line 15: GL012
+        scalar = device_value.item()        # line 16: GL013
+        listed = device_value.tolist()      # line 17: GL012
+        suppressed = int(step_out)          # graft-ok: GL011 host counter
+        del arr, scalar, listed, suppressed
+    return total
+
+
+def cold_path(device_value):
+    # same constructs OUTSIDE a registered/marked hot path: not flagged
+    return float(device_value) + np.asarray(device_value).sum()

@@ -1,0 +1,39 @@
+"""Seeded GL02x violations: impurity / recompile hazards under jit.
+
+NOT importable production code — a fixture the analyzer tests run the
+checkers over. Line positions matter to the tests; edit with care.
+"""
+
+import random
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def impure_step(state, batch, flag):
+    print("tracing impure_step")            # line 15: GL021
+    t0 = time.perf_counter()                # line 16: GL022
+    noise = random.random()                 # line 17: GL023
+    if flag:                                # line 18: GL024 (traced arg)
+        state = state + noise
+    return state + batch.sum() + t0
+
+
+jitted = jax.jit(impure_step)
+
+
+class Holder:
+    def jit_method(self, x):
+        self.last_x = x                     # line 28: GL025 (self write)
+        return jnp.tanh(x)
+
+    def build(self):
+        self._fn = jax.jit(self.jit_method)
+        return self._fn
+
+
+def fresh_jit_every_call(params, x):
+    # line 37: GL026 — fresh lambda jitted per call defeats the jit cache
+    fwd = jax.jit(lambda p, t: (p * t).sum())
+    return fwd(params, x)

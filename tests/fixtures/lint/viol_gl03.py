@@ -1,0 +1,64 @@
+"""Seeded GL03x violations: lock-discipline breaches + an order cycle.
+
+NOT importable production code — a fixture the analyzer tests run the
+checkers over. Line positions matter to the tests; edit with care.
+"""
+
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.hits = 0                        # guarded-by: _lock
+        self.phantom = 0                     # guarded-by: _no_such_lock
+
+    def locked_bump(self):
+        with self._lock:
+            self.hits += 1                   # fine: under the lock
+
+    def racy_bump(self):
+        self.hits += 1                       # line 21: GL031 (write)
+
+    def racy_read(self):
+        return self.hits                     # line 24: GL031 (read)
+
+    def suppressed_read(self):
+        return self.hits                     # graft-ok: GL031 display only
+
+    # holds: _lock
+    def documented_helper(self):
+        self.hits += 1                       # fine: caller holds it
+
+
+class AB:
+    """Acquires lock_a, then calls into BA (which takes lock_b)."""
+
+    def __init__(self, other):
+        self.lock_a = threading.Lock()
+        self.other = other
+
+    def forward(self):
+        with self.lock_a:
+            self.other.take_b()             # edge: AB.lock_a -> BA.lock_b
+
+    def take_a(self):
+        with self.lock_a:
+            pass
+
+
+class BA:
+    """Acquires lock_b, then calls into AB (which takes lock_a) —
+    closing the cycle AB.lock_a -> BA.lock_b -> AB.lock_a (GL032)."""
+
+    def __init__(self, other):
+        self.lock_b = threading.Lock()
+        self.other = other
+
+    def take_b(self):
+        with self.lock_b:
+            pass
+
+    def backward(self):
+        with self.lock_b:
+            self.other.take_a()             # edge: BA.lock_b -> AB.lock_a

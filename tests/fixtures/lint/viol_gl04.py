@@ -1,0 +1,20 @@
+"""Seeded GL04x violations: telemetry-schema drift.
+
+NOT importable production code — a fixture the analyzer tests run the
+checkers over. Line positions matter to the tests; edit with care.
+"""
+
+from building_llm_from_scratch_tpu.obs.metrics import emit_event, get_metrics
+
+# line 10: GL044 — private copy of a schema table
+TICK_PHASES = ("admit", "prefill", "decode_dispatch")
+
+
+def emit_everything(sink):
+    emit_event("totally_unknown_event", foo=1)        # line 15: GL041
+    emit_event("checkpoint_save", path="/x",
+               made_up_field=3)                       # line 17: GL042
+    emit_event("checkpoint_save", seconds=1.0)        # line 18: GL043 (no path)
+    sink.event("retry", describe="fetch", attempt=1)  # fine
+    get_metrics().event("request_failed",
+                        request_id=1, reason="x")     # fine
