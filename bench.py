@@ -640,6 +640,95 @@ def bench_serve_load(n_slots=4, max_new=24, prompt_len=16,
             completed_at_1x * max_new)
 
 
+def bench_serve_lora(n_adapters=3, n_requests=16, max_new=24,
+                     prompt_len=16, rank=8, n_slots=4):
+    """Multi-tenant LoRA serving A/B (serving/adapters.py): the SAME
+    request set decoded (a) by the historical registry-less engine,
+    (b) by an adapter-pooled engine serving base-only traffic (the pure
+    overhead of carrying the pool through the compiled programs), and
+    (c) mixed traffic round-robining ``n_adapters`` adapters + base —
+    the multi-tenant case a merge-based LoRA deployment cannot co-batch
+    at all. Every arm must finish with ZERO recompiles (adapter identity
+    is data, not a compile signature).
+
+    bf16 on TPU, fp32 elsewhere (same policy as ``bench_serve``)."""
+    import tempfile
+    import time
+
+    from building_llm_from_scratch_tpu.configs import get_config
+    from building_llm_from_scratch_tpu.generate import _bucket
+    from building_llm_from_scratch_tpu.models import init_params
+    from building_llm_from_scratch_tpu.models.lora import (
+        init_lora_params,
+        save_adapter,
+    )
+    from building_llm_from_scratch_tpu.serving import (
+        AdapterRegistry,
+        DecodeEngine,
+        SamplingParams,
+    )
+
+    dtype = "bf16" if jax.default_backend() == "tpu" else "fp32"
+    cfg = get_config("GPT2", "124M", dtype=dtype)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(0, cfg.vocab_size,
+                           (n_requests, prompt_len)).astype(np.int32)
+
+    art_dir = tempfile.mkdtemp(prefix="bench_serve_lora_")
+    specs = {}
+    for i in range(n_adapters):
+        lora = init_lora_params(cfg, params, jax.random.PRNGKey(100 + i),
+                                rank=rank)
+        lora = jax.tree_util.tree_map(
+            lambda a, i=i: a + 0.02 * jax.random.normal(
+                jax.random.PRNGKey(200 + i), a.shape, a.dtype), lora)
+        path = os.path.join(art_dir, f"adapter_{i}.npz")
+        save_adapter(path, lora, rank=rank, alpha=2.0 * rank, cfg=cfg)
+        specs[f"tenant{i}"] = path
+
+    def run_arm(adapters, names):
+        eng = DecodeEngine(cfg, params, n_slots=n_slots,
+                           max_len=_bucket(prompt_len + max_new),
+                           max_queue=n_requests,
+                           warmup_prompt_cap=prompt_len, adapters=adapters)
+        eng.warmup()
+        t0 = time.perf_counter()
+        handles = [eng.submit(p, SamplingParams(
+            max_new_tokens=max_new, ignore_eos=True, seed=i,
+            adapter=names[i % len(names)]), block=True)
+            for i, p in enumerate(prompts)]
+        eng.run_until_idle()
+        dt = time.perf_counter() - t0
+        for h in handles:
+            assert len(h.output_ids) == max_new, h.error
+        assert eng.n_recompiles == 0, "adapter traffic recompiled"
+        tok_s = n_requests * max_new / dt
+        eng.shutdown()
+        return tok_s
+
+    base_tok_s = run_arm(None, [None])
+    reg = AdapterRegistry.from_artifacts(cfg, params, specs)
+    pool_tok_s = run_arm(reg, [None])
+    mixed_names = [None] + list(specs)
+    mixed_tok_s = run_arm(reg, mixed_names)
+    detail = {
+        "no_registry": {"tok_s": round(base_tok_s, 1)},
+        "registry_base_only": {
+            "tok_s": round(pool_tok_s, 1),
+            "vs_no_registry": round(pool_tok_s / base_tok_s, 3)},
+        "mixed_adapters": {
+            "tok_s": round(mixed_tok_s, 1),
+            "n_adapters": n_adapters, "rank": rank,
+            "vs_no_registry": round(mixed_tok_s / base_tok_s, 3)},
+        "recompiles": 0,
+    }
+    print(json.dumps(detail), flush=True)
+    return (f"serve_lora tokens/sec GPT2-124M {dtype} {n_requests}req x "
+            f"{max_new}new {n_adapters}adapters+base slots{n_slots}",
+            mixed_tok_s)
+
+
 BENCHES = {
     "headline": bench_headline,
     "cfg1": bench_cfg1,
@@ -653,6 +742,7 @@ BENCHES = {
     "decode": bench_decode,
     "serve": bench_serve,
     "serve_load": bench_serve_load,
+    "serve_lora": bench_serve_lora,
 }
 
 

@@ -49,6 +49,14 @@ HOT_PATHS = {
         "DecodeEngine.step",
         "DecodeEngine._admit",
         "DecodeEngine._accept_token",
+        "DecodeEngine._pool_args",
+        "DecodeEngine._pool_args_for",
+    },
+    "building_llm_from_scratch_tpu/serving/adapters.py": {
+        # the engine's per-tick / per-admission registry reads: must stay
+        # lock-free reference snapshots with zero device syncs
+        "AdapterRegistry.pool_args",
+        "AdapterRegistry.lookup",
     },
     "building_llm_from_scratch_tpu/data/prefetch.py": {
         "Prefetcher._fill",

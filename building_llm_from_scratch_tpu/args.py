@@ -97,6 +97,25 @@ def perform_checks(args) -> None:
         if args.serve_metrics_every < 0:
             raise ValueError("--serve_metrics_every must be >= 0 "
                              "(0 disables the tick cadence rows).")
+        if args.serve_adapter_slots < 0:
+            raise ValueError("--serve_adapter_slots must be >= 0 "
+                             "(0 = sized to the listed adapters).")
+        if args.serve_adapters:
+            from building_llm_from_scratch_tpu.serving.frontend import (
+                parse_adapter_specs,
+            )
+
+            specs = parse_adapter_specs(args.serve_adapters)
+            if 0 < args.serve_adapter_slots < len(specs):
+                raise ValueError(
+                    f"--serve_adapter_slots {args.serve_adapter_slots} "
+                    f"cannot hold the {len(specs)} adapters listed in "
+                    "--serve_adapters.")
+            for name, path in specs.items():
+                if not os.path.isfile(path):
+                    raise FileNotFoundError(
+                        f"--serve_adapters '{name}': artifact '{path}' "
+                        "does not exist.")
     else:
         # every serve flag, not just the workload pair: a non-default
         # value outside serve mode is a mistyped/missing --mode serve,
@@ -109,6 +128,7 @@ def perform_checks(args) -> None:
             ("serve_host", "127.0.0.1"), ("drain_timeout", 30.0),
             ("serve_tick_timeout", 0.0), ("serve_max_restarts", 3),
             ("serve_deadline_s", 0.0), ("serve_metrics_every", 32),
+            ("serve_adapters", None), ("serve_adapter_slots", 0),
         ) if getattr(args, name) != default]
         if stray:
             raise ValueError(
@@ -214,6 +234,11 @@ def perform_checks(args) -> None:
 
     if args.use_lora and args.lora_rank < 1:
         raise ValueError("--lora_rank must be >= 1.")
+    if args.save_adapter and not args.use_lora:
+        raise ValueError("--save_adapter requires --use_lora (there is "
+                         "no adapter to export otherwise).")
+    if args.save_adapter and args.mode == "serve":
+        raise ValueError("--save_adapter is a training-mode export.")
 
     # fp16 params with a non-fp16 policy would bypass the loss scaler and
     # silently underflow gradients (round-2 VERDICT weak #4); fp16 alone is
@@ -356,6 +381,20 @@ def get_args(argv=None):
                              "504) and admission rejects up front when "
                              "the backlog already predicts a miss (HTTP "
                              "429 + Retry-After). 0 = no default.")
+    parser.add_argument("--serve_adapters", type=str, default=None,
+                        help="Multi-tenant LoRA serving: comma-separated "
+                             "name=path pairs of adapter artifacts "
+                             "(--save_adapter npz files) loaded into the "
+                             "engine's device-resident adapter pool. "
+                             "Requests pick one with their 'adapter' "
+                             "field; base-model traffic co-batches with "
+                             "any adapter mix in the ONE compiled decode "
+                             "program.")
+    parser.add_argument("--serve_adapter_slots", type=int, default=0,
+                        help="Static adapter-pool capacity (rows) for "
+                             "--serve_adapters; hot-loads beyond it are "
+                             "refused. 0 = number of listed adapters + 1 "
+                             "spare hot-load row.")
     parser.add_argument("--serve_metrics_every", type=int, default=32,
                         help="Engine metrics cadence in decode ticks: "
                              "each cadence writes one metrics row with "
@@ -524,6 +563,13 @@ def get_args(argv=None):
                         help="LoRA rank.")
     parser.add_argument("--lora_alpha", type=float, default=32,
                         help="LoRA alpha.")
+    parser.add_argument("--save_adapter", type=str, default=None,
+                        help="After a --use_lora run, export the trained "
+                             "adapter as a standalone npz artifact "
+                             "(A/B tree + rank/alpha + base-config "
+                             "fingerprint) loadable by --serve_adapters "
+                             "— the finetune -> multi-tenant-serving "
+                             "hand-off.")
 
     # Tokenizer (TPU/offline additions)
     parser.add_argument("--tokenizer_path", type=str, default=None,

@@ -32,6 +32,7 @@ from building_llm_from_scratch_tpu.models.transformer import (
     forward_with_cache,
     init_cache,
     unstack_blocks,
+    unstack_lora_blocks,
 )
 
 
@@ -100,13 +101,15 @@ def _bucket(n: int, step: int = 64, lo: int = 32) -> int:
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",))
-def _forward_window(params, cfg: ModelConfig, tokens: jnp.ndarray):
+def _forward_window(params, cfg: ModelConfig, tokens: jnp.ndarray,
+                    lora=None, lora_scaling=1.0):
     """Full forward over one padded window (the sliding-window fallback's
     per-token program). Module-level jit on purpose: the jit cache keys
     on the callable's identity, so the previous ``jax.jit(lambda ...)``
     built inside ``generate()`` recompiled this forward on EVERY
     fallback call (graft-lint GL026)."""
-    return forward(params, cfg, tokens)
+    return forward(params, cfg, tokens, lora=lora,
+                   lora_scaling=lora_scaling)
 
 
 @functools.partial(
@@ -117,7 +120,8 @@ def _generate_cached(params, cfg: ModelConfig, prompt: jnp.ndarray,
                      prompt_len: jnp.ndarray, rng: jax.Array,
                      max_new_tokens: jnp.ndarray, budget: int,
                      temperature: float, top_k: Optional[int],
-                     eos_id: Optional[int], ref_eos: bool):
+                     eos_id: Optional[int], ref_eos: bool,
+                     lora=None, lora_scaling=1.0):
     """KV-cache decode over BUCKETED shapes.
 
     ``prompt`` is right-padded to its length bucket; ``prompt_len`` (traced)
@@ -149,9 +153,13 @@ def _generate_cached(params, cfg: ModelConfig, prompt: jnp.ndarray,
     # per-layer weight slices hoisted OUT of the sampling loop (see
     # unstack_blocks: in-loop slicing re-laid-out weights every token)
     blocks_list = unstack_blocks(params, cfg)
+    lora_blocks_list = (unstack_lora_blocks(lora, cfg)
+                        if lora is not None else None)
+    lora_kw = dict(lora=lora, lora_scaling=lora_scaling,
+                   lora_blocks_list=lora_blocks_list)
 
     logits, cache = forward_with_cache(params, cfg, prompt, cache,
-                                       blocks_list)
+                                       blocks_list, **lora_kw)
     # real prompt occupies [0, prompt_len); pad slots hold garbage k/v that
     # decode overwrites (and kv_length masks meanwhile)
     cache = dict(cache, length=prompt_len)
@@ -196,7 +204,8 @@ def _generate_cached(params, cfg: ModelConfig, prompt: jnp.ndarray,
             done = done | newly
             n_gen = n_gen + alive.astype(n_gen.dtype)
         new_logits, cache = forward_with_cache(
-            params, cfg, nxt[:, None].astype(jnp.int32), cache, blocks_list)
+            params, cfg, nxt[:, None].astype(jnp.int32), cache, blocks_list,
+            **lora_kw)
         return (buf, cache, new_logits[:, -1], i + 1, done, n_gen)
 
     carry = (buf, cache, last, jnp.zeros((), jnp.int32),
@@ -211,7 +220,9 @@ def generate(params, cfg: ModelConfig, token_ids, max_new_tokens: int,
              top_k: Optional[int] = None, eos_id: Optional[int] = None,
              rng: Optional[jax.Array] = None,
              ref_eos_semantics: bool = False,
-             return_n_generated: bool = False) -> np.ndarray:
+             return_n_generated: bool = False,
+             lora=None, lora_alpha: Optional[float] = None,
+             lora_rank: Optional[int] = None) -> np.ndarray:
     """Generate up to ``max_new_tokens`` after ``token_ids`` (B, Tp).
 
     Returns a numpy (B, Tp + max_row_generated) array, mirroring the
@@ -224,8 +235,19 @@ def generate(params, cfg: ModelConfig, token_ids, max_new_tokens: int,
     neither stops it nor is dropped (generate.py:68-73) — for bit-parity
     against the reference. ``return_n_generated=True`` additionally
     returns the per-row generated-token counts (B,).
+
+    ``lora`` (+ ``lora_alpha``/``lora_rank``): decode with an UNMERGED
+    LoRA adapter — the delta rides every adapted projection via
+    ``models.lora.apply_lora`` instead of materializing merged weights.
+    Same math as ``merge_lora`` (token-parity-tested); what the trainer's
+    eval sampling and the serving engine share.
     """
     context_size = context_size or cfg.context_length
+    lora_scaling = 1.0
+    if lora is not None:
+        if lora_alpha is None or lora_rank is None:
+            raise ValueError("lora needs lora_alpha and lora_rank")
+        lora_scaling = float(lora_alpha) / float(lora_rank)
     token_ids = jnp.asarray(token_ids, jnp.int32)
     if token_ids.ndim == 1:
         token_ids = token_ids[None, :]
@@ -251,7 +273,8 @@ def generate(params, cfg: ModelConfig, token_ids, max_new_tokens: int,
                                       jnp.asarray(Tp, jnp.int32), rng,
                                       jnp.asarray(max_new_tokens, jnp.int32),
                                       budget, float(temperature),
-                                      top_k, eos_id, bool(ref_eos_semantics))
+                                      top_k, eos_id, bool(ref_eos_semantics),
+                                      lora, lora_scaling)
         # ONE device_get for both results: on remote/tunnel backends each
         # transfer costs ~100ms of latency regardless of size (measured
         # r4: separate int(n)+asarray(buf) fetches added 119ms/call)
@@ -264,7 +287,8 @@ def generate(params, cfg: ModelConfig, token_ids, max_new_tokens: int,
     # ``context_size`` are right-padded (causality makes the padding inert)
     # and the logits are read at the true last position. Without this, every
     # growing prompt length would trigger a fresh XLA compile.
-    fwd = lambda p, t: _forward_window(p, cfg, t)  # noqa: E731
+    fwd = lambda p, t: _forward_window(p, cfg, t, lora,  # noqa: E731
+                                       lora_scaling)
     ids = np.asarray(token_ids)
     done = np.zeros((B,), bool)
     n_gen = np.zeros((B,), np.int32)

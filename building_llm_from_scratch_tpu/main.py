@@ -236,6 +236,11 @@ def main(args):
     # 9. final checkpoint + single-file export (reference main.py:171-172)
     trainer.save_checkpoint("final")
     trainer.export_final("model_pg_final.npz")
+    if getattr(args, "save_adapter", None):
+        # standalone LoRA artifact for multi-tenant serving
+        # (--serve_adapters); export_final above stays the MERGED
+        # single-tenant export
+        trainer.export_adapter(args.save_adapter)
     emit_event("run_complete", step=trainer.global_step,
                tokens_seen=trainer.tokens_seen,
                final_train_loss=(trainer.train_losses[-1]

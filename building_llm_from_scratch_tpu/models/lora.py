@@ -28,15 +28,22 @@ Init parity (reference lora.py:6-26): A ~ kaiming-uniform(a=sqrt(5)) over
 
 from __future__ import annotations
 
+import hashlib
+import json
 import math
-from typing import Any, Dict
+import os
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from building_llm_from_scratch_tpu.configs import ModelConfig
 
 Params = Dict[str, Any]
+
+#: adapter artifact (.npz) format version — bump on layout changes
+ADAPTER_FORMAT_VERSION = 1
 
 # model-tree linear weights that receive adapters: path -> (stacked?, in_axis)
 _ADAPTED = {
@@ -109,9 +116,149 @@ def merge_lora(params: Params, lora: Params, alpha: float, rank: int) -> Params:
     return walk(params, lora)
 
 
+def apply_lora(x: jnp.ndarray, w: jnp.ndarray, node: Optional[Params],
+               scaling=None) -> jnp.ndarray:
+    """Merge-free adapted projection: ``x @ w + s * ((x @ A) @ B)``.
+
+    The unmerged twin of ``merge_lora`` — same math, applied at the
+    activation instead of the weight, so ONE base ``w`` serves many
+    adapters at once (the multi-tenant serving requirement; merging
+    would need a weight copy per adapter). Shared by the trainer's
+    eval sampling (``generate(..., lora=...)``) and the serving
+    engine's per-slot path (models/transformer.py slot functions).
+
+    ``node``: ``{"A", "B"}``, either unbatched (``(in, r)``/``(r, out)``
+    — one adapter for the whole batch) or per-row batched
+    (``(B, in, r)``/``(B, r, out)`` — the engine's BGMV gather output).
+    ``None`` returns exactly ``x @ w`` (bit-identical base path).
+    ``scaling``: alpha/rank — a scalar, or ``(B,)`` per-row scales
+    (0 = zero delta, the id −1 base-model row). A node carrying a
+    ``"bgmv"`` entry routes the delta through the fused TPU kernel
+    (ops/decode_step.lora_bgmv) instead of the gathered einsum.
+    """
+    h = x @ w
+    if node is None:
+        return h
+    if "bgmv" in node:
+        from building_llm_from_scratch_tpu.ops.decode_step import lora_bgmv
+
+        a_pool, b_pool, ids, scales = node["bgmv"]
+        # x (S, 1, D) single-token decode rows -> (S, D); kernel returns
+        # the already-scaled (S, O) delta
+        delta = lora_bgmv(x[:, 0], a_pool, b_pool, ids, scales)
+        return h + delta[:, None].astype(h.dtype)
+    return h + lora_delta(x, node, scaling).astype(h.dtype)
+
+
+def lora_delta(x: jnp.ndarray, node: Params, scaling) -> jnp.ndarray:
+    """The scaled unmerged delta ``s * ((x @ A) @ B)`` — ONE definition
+    of the application math, shared by ``apply_lora`` and the LM-head
+    path (models/transformer._head_logits), so scaling/broadcast
+    semantics cannot drift between projection sites. ``scaling`` is a
+    scalar or per-row ``(B,)``."""
+    delta = (x @ node["A"]) @ node["B"]
+    s = jnp.asarray(scaling, jnp.float32)
+    if s.ndim == 1:                       # (B,) per-row scales
+        s = s[:, None, None]
+    return s * delta
+
+
 def count_lora_params(lora: Params) -> int:
     """Trainable-parameter count (reference build_components.py:131-135)."""
-    import numpy as np
-
     return int(sum(np.prod(l.shape)
                    for l in jax.tree_util.tree_leaves(lora)))
+
+
+# ---------------------------------------------------------------------------
+# Adapter artifacts (.npz): the finetune -> serve hand-off
+# ---------------------------------------------------------------------------
+
+#: ModelConfig fields that define the ARCHITECTURE an adapter was trained
+#: against. dtype / attn_impl / remat are runtime choices — an adapter is
+#: portable across them — but any mismatch here means the A/B matrices
+#: multiply against different-shaped (or differently-wired) weights.
+_FINGERPRINT_FIELDS = (
+    "name", "vocab_size", "context_length", "emb_dim", "n_heads",
+    "n_layers", "hidden_dim", "n_kv_groups", "norm", "positional",
+    "activation", "qkv_bias", "attn_out_bias", "mlp_bias", "norm_bias",
+)
+
+
+def adapter_fingerprint(cfg: ModelConfig) -> str:
+    """Short stable hash of the base architecture an adapter binds to."""
+    ident = {f: getattr(cfg, f) for f in _FINGERPRINT_FIELDS}
+    blob = json.dumps(ident, sort_keys=True).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
+def _flatten_adapter(lora: Params) -> Dict[str, Any]:
+    flat = jax.tree_util.tree_flatten_with_path(lora)[0]
+    return {".".join(p.key for p in path): leaf for path, leaf in flat}
+
+
+def save_adapter(path: str, lora: Params, *, rank: int, alpha: float,
+                 cfg: ModelConfig) -> str:
+    """Write one LoRA adapter as a standalone npz artifact: the A/B tree
+    (dotted-path keys), per-array dtypes (np.savez stores ml_dtypes
+    arrays as raw void bytes — same trick as ``checkpoint.export_params``)
+    and a JSON metadata record carrying (rank, alpha, base-config
+    fingerprint). The serving ``AdapterRegistry`` refuses artifacts whose
+    fingerprint does not match its loaded base model."""
+    arrays: Dict[str, Any] = {}
+    for key, leaf in _flatten_adapter(lora).items():
+        arr = np.asarray(jax.device_get(leaf))
+        arrays[key] = arr
+        arrays[f"__dtype__.{key}"] = np.asarray(str(arr.dtype))
+    meta = {
+        "format": ADAPTER_FORMAT_VERSION,
+        "rank": int(rank),
+        "alpha": float(alpha),
+        "fingerprint": adapter_fingerprint(cfg),
+        "model": cfg.name,
+    }
+    arrays["__adapter_meta__"] = np.asarray(json.dumps(meta))
+    if jax.process_index() == 0:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = path + ".tmp"
+        np.savez(tmp, **arrays)
+        # np.savez appends .npz to paths without it
+        os.replace(tmp if os.path.exists(tmp) else tmp + ".npz", path)
+    return path
+
+
+def load_adapter(path: str) -> Tuple[Params, Dict[str, Any]]:
+    """Load a ``save_adapter`` artifact -> (lora tree of np arrays, meta).
+
+    Raises ``ValueError`` for files without adapter metadata (a model
+    export or token cache passed by mistake) or from a newer format."""
+    data = np.load(path, allow_pickle=False)
+    if "__adapter_meta__" not in data:
+        raise ValueError(
+            f"{path} is not an adapter artifact (no __adapter_meta__; "
+            "write one with --save_adapter / models.lora.save_adapter)")
+    meta = json.loads(str(data["__adapter_meta__"]))
+    if meta.get("format", 0) > ADAPTER_FORMAT_VERSION:
+        raise ValueError(
+            f"{path}: adapter format {meta.get('format')} is newer than "
+            f"this build supports ({ADAPTER_FORMAT_VERSION})")
+    lora: Params = {}
+    for key in data.files:
+        if key.startswith("__"):
+            continue
+        arr = data[key]
+        dt_key = f"__dtype__.{key}"
+        if dt_key in data:
+            # np.load returns ml_dtypes arrays (bf16) as raw void bytes; a
+            # view restores them losslessly (checkpoint._restore_dtype)
+            target = np.dtype(str(data[dt_key]))
+            if arr.dtype != target:
+                arr = (arr.view(target)
+                       if (arr.dtype.kind == "V"
+                           and arr.dtype.itemsize == target.itemsize)
+                       else arr.astype(target))
+        node = lora
+        parts = key.split(".")
+        for name in parts[:-1]:
+            node = node.setdefault(name, {})
+        node[parts[-1]] = arr
+    return lora, meta

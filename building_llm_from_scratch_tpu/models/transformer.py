@@ -52,6 +52,7 @@ import jax.numpy as jnp
 from jax.ad_checkpoint import checkpoint_name
 
 from building_llm_from_scratch_tpu.configs import ModelConfig
+from building_llm_from_scratch_tpu.models.lora import apply_lora, lora_delta
 from building_llm_from_scratch_tpu.ops.attention import (
     causal_attention,
     decode_attention,
@@ -64,6 +65,65 @@ from building_llm_from_scratch_tpu.ops.rope import (
 )
 
 Params = Dict[str, Any]
+
+
+# ---------------------------------------------------------------------------
+# LoRA adapter application (merge-free; models/lora.apply_lora is the
+# shared projection helper)
+#
+# Two shapes of "adapter" flow through the forward passes:
+#   - a single unmerged adapter tree (``lora=`` on forward/forward_with_
+#     cache): every batch row shares one {"A","B"} node per projection —
+#     the trainer's eval-sampling path;
+#   - a per-row adapter POOL (``adapter=`` on the slot-batched serving
+#     functions): stacked ``(n_adapters_max, ...)`` A/B leaves plus a
+#     per-row ``ids`` vector — Punica/S-LoRA-style BGMV, where adapter
+#     identity is DATA, so hot-loading adapters never recompiles and one
+#     decode program serves arbitrary adapter mixes (id −1 = base model,
+#     exact zero delta).
+# ---------------------------------------------------------------------------
+
+def _block_adp(lb: Params, s) -> Params:
+    """Per-layer adapter argument for ``_block``/the slot loops: the lora
+    blocks node (attn/mlp, each projection a {"A","B"}) + the scale."""
+    return {"attn": dict(lb["attn"], s=s), "mlp": dict(lb["mlp"], s=s)}
+
+
+def _adapter_rows(pool: Params, scaling: jnp.ndarray, ids: jnp.ndarray):
+    """BGMV gather: per-row adapter matrices from the stacked pool.
+
+    ``pool`` mirrors the lora tree with a leading ``(n_adapters_max,)``
+    axis on every leaf; ``ids`` (B,) int32 selects one pool row per batch
+    row (−1 = base model: the index clamps into range but the gathered
+    scale is forced to 0, so the delta is exactly zero regardless of what
+    the clamped row holds)."""
+    idx = jnp.clip(ids.astype(jnp.int32), 0, scaling.shape[0] - 1)
+    s = jnp.where(ids >= 0, jnp.take(scaling, idx, axis=0), 0.0)
+    rows = jax.tree_util.tree_map(lambda a: jnp.take(a, idx, axis=0), pool)
+    return rows, s
+
+
+def unstack_lora_blocks(lora: Params, cfg: ModelConfig) -> list:
+    """Per-layer views of a stacked lora tree's ``blocks`` node — the
+    adapter twin of ``unstack_blocks`` (hoisted out of sampling loops for
+    the same re-layout reason)."""
+    return [
+        jax.tree_util.tree_map(lambda a, l=l: a[l], lora["blocks"])
+        for l in range(cfg.n_layers)
+    ]
+
+
+def _head_logits(x: jnp.ndarray, w: jnp.ndarray,
+                 node: Optional[Params] = None,
+                 scaling=None) -> jnp.ndarray:
+    """LM-head projection (+ optional unmerged LoRA delta). The base
+    einsum is byte-for-byte the historical head path; the delta rides on
+    top in fp32 like ``apply_lora``."""
+    logits = jnp.einsum("btd,dv->btv", x, w,
+                        preferred_element_type=jnp.float32)
+    if node is None:
+        return logits
+    return logits + lora_delta(x, node, scaling).astype(jnp.float32)
 
 
 # ---------------------------------------------------------------------------
@@ -140,24 +200,30 @@ def _norm(cfg: ModelConfig, p: Params, x: jnp.ndarray) -> jnp.ndarray:
 
 
 def _mlp(cfg: ModelConfig, p: Params, x: jnp.ndarray,
-         tp_axis: Optional[str] = None) -> jnp.ndarray:
+         tp_axis: Optional[str] = None,
+         adp: Optional[Params] = None) -> jnp.ndarray:
     """MLP. ``tp_axis``: Megatron column-parallel up/gate (+ their biases,
     which are feature-sharded like the weights) and row-parallel down with
-    an explicit psum; the replicated down bias is added once after."""
+    an explicit psum; the replicated down bias is added once after.
+    ``adp``: optional unmerged LoRA nodes per projection (+ ``"s"`` scale;
+    does not compose with tp — adapters see the FULL weight)."""
+    s = adp["s"] if adp is not None else None
+    n = (lambda name: adp.get(name)) if adp is not None else (lambda _: None)
     if cfg.activation == "swiglu":
         # silu(gate(x)) * up(x) -> down   (reference common_components.py:95-124)
-        g = checkpoint_name(x @ p["gate"], "gate_out")
-        u = checkpoint_name(x @ p["up"], "up_out")
-        h = (silu(g) * u) @ p["down"]
+        g = checkpoint_name(apply_lora(x, p["gate"], n("gate"), s),
+                            "gate_out")
+        u = checkpoint_name(apply_lora(x, p["up"], n("up"), s), "up_out")
+        h = apply_lora(silu(g) * u, p["down"], n("down"), s)
         if tp_axis is not None:
             h = jax.lax.psum(h, tp_axis)
         return h
-    h = x @ p["up"]
+    h = apply_lora(x, p["up"], n("up"), s)
     if "b_up" in p:
         h = h + p["b_up"]
     h = checkpoint_name(h, "up_out")
     h = gelu(h)
-    h = h @ p["down"]
+    h = apply_lora(h, p["down"], n("down"), s)
     if tp_axis is not None:
         h = jax.lax.psum(h, tp_axis)
     if "b_down" in p:
@@ -205,16 +271,21 @@ def _residual_dropout(x: jnp.ndarray, h: jnp.ndarray, rate: float,
 
 
 def _qkv_proj(cfg: ModelConfig, p: Params, x: jnp.ndarray,
-              rope, positions):
+              rope, positions, adp: Optional[Params] = None):
     """Shared q/k/v projection (+biases, head reshape, RoPE) — the single
     source of truth for the attention parameterization, used by BOTH the
     training path (_attention) and the KV-cache decode body
-    (forward_with_cache); divergence here would silently break decode."""
+    (forward_with_cache); divergence here would silently break decode.
+    ``adp``: optional unmerged LoRA nodes (wq/wk/wv + ``"s"``), applied
+    BEFORE the head reshape and RoPE — exactly where a merged weight's
+    delta would land."""
     B, Tq, _ = x.shape
     hd = cfg.head_dim
-    q = x @ p["wq"]
-    k = x @ p["wk"]
-    v = x @ p["wv"]
+    s = adp["s"] if adp is not None else None
+    n = (lambda name: adp.get(name)) if adp is not None else (lambda _: None)
+    q = apply_lora(x, p["wq"], n("wq"), s)
+    k = apply_lora(x, p["wk"], n("wk"), s)
+    v = apply_lora(x, p["wv"], n("wv"), s)
     if "bq" in p:
         q, k, v = q + p["bq"], k + p["bk"], v + p["bv"]
     # head counts come from the PROJECTED widths, not the config: under
@@ -236,11 +307,14 @@ def _qkv_proj(cfg: ModelConfig, p: Params, x: jnp.ndarray,
 
 
 def _attn_out_proj(p: Params, out: jnp.ndarray, B: int, Tq: int,
-                   tp_axis: Optional[str] = None) -> jnp.ndarray:
+                   tp_axis: Optional[str] = None,
+                   adp: Optional[Params] = None) -> jnp.ndarray:
     """Output projection; with ``tp_axis`` (Megatron row-parallel wo inside
     a shard_map) the partial products psum over the model axis and the
     bias — replicated, not sharded — is added exactly once AFTER."""
-    out = out.reshape(B, Tq, -1) @ p["wo"]
+    out = apply_lora(out.reshape(B, Tq, -1), p["wo"],
+                     adp.get("wo") if adp is not None else None,
+                     adp["s"] if adp is not None else None)
     if tp_axis is not None:
         out = jax.lax.psum(out, tp_axis)
     if "bo" in p:
@@ -254,12 +328,12 @@ def _attention(cfg: ModelConfig, p: Params, x: jnp.ndarray,
                cache_kv: Optional[Tuple[jnp.ndarray, jnp.ndarray]],
                cache_len: Optional[jnp.ndarray],
                rng: Optional[jax.Array], deterministic: bool,
-               sp_mesh=None, sp_inside=None, tp_axis=None):
+               sp_mesh=None, sp_inside=None, tp_axis=None, adp=None):
     """Per-block attention; returns (out, new_cache_kv)."""
     B, Tq, D = x.shape
     hd = cfg.head_dim
 
-    q, k, v = _qkv_proj(cfg, p, x, rope, positions)
+    q, k, v = _qkv_proj(cfg, p, x, rope, positions, adp=adp)
 
     new_cache = None
     if cache_kv is not None:
@@ -318,13 +392,13 @@ def _attention(cfg: ModelConfig, p: Params, x: jnp.ndarray,
             impl=cfg.attn_impl,
         )
     out = checkpoint_name(out, "attn_out")
-    out = _attn_out_proj(p, out, B, Tq, tp_axis=tp_axis)
+    out = _attn_out_proj(p, out, B, Tq, tp_axis=tp_axis, adp=adp)
     return out, new_cache
 
 
 def _block(cfg: ModelConfig, p: Params, x: jnp.ndarray,
            rope, positions, cache_kv, cache_len, rng, deterministic,
-           sp_mesh=None, sp_inside=None, tp_axis=None):
+           sp_mesh=None, sp_inside=None, tp_axis=None, adp=None):
     """Pre-norm transformer block (reference GPT2.py:68-88, Llama3.py:159-181).
 
     ``tp_axis``: Megatron tensor parallelism INSIDE a shard_map — the
@@ -348,10 +422,12 @@ def _block(cfg: ModelConfig, p: Params, x: jnp.ndarray,
     h, new_cache = _attention(cfg, p["attn"], _norm(cfg, p["norm1"], x),
                               rope, positions, cache_kv, cache_len,
                               r_attn, deterministic, sp_mesh=sp_mesh,
-                              sp_inside=sp_inside, tp_axis=tp_axis)
+                              sp_inside=sp_inside, tp_axis=tp_axis,
+                              adp=adp["attn"] if adp is not None else None)
     x = _residual_dropout(x, h, cfg.drop_rate, r_res1, deterministic)
     x = checkpoint_name(x, "resid_mid")
-    h = _mlp(cfg, p["mlp"], _norm(cfg, p["norm2"], x), tp_axis=tp_axis)
+    h = _mlp(cfg, p["mlp"], _norm(cfg, p["norm2"], x), tp_axis=tp_axis,
+             adp=adp["mlp"] if adp is not None else None)
     x = _residual_dropout(x, h, cfg.drop_rate, r_res2, deterministic)
     return x, new_cache
 
@@ -407,12 +483,19 @@ def _embed(cfg: ModelConfig, params: Params, tokens: jnp.ndarray,
 def forward_hidden(params: Params, cfg: ModelConfig, tokens: jnp.ndarray, *,
                    rng: Optional[jax.Array] = None,
                    deterministic: bool = True,
-                   sp_mesh=None, sp_inside=None) -> jnp.ndarray:
+                   sp_mesh=None, sp_inside=None,
+                   lora: Optional[Params] = None,
+                   lora_scaling=1.0) -> jnp.ndarray:
     """Forward up to (and including) the final norm — the (B, T, D) hidden
     states BEFORE the output head. The training loss path consumes this
     directly via ops/softmax_xent.py so (B, T, V) fp32 logits never
     materialize; ``forward`` below adds the head for logits consumers
-    (generation, tests, golden-logit parity)."""
+    (generation, tests, golden-logit parity).
+
+    ``lora``: optional unmerged adapter tree (models/lora.py layout),
+    applied at every adapted projection via ``apply_lora`` — the
+    merge-free path serving shares. Not composable with tp/sp sharding
+    (adapters multiply against the full weights)."""
     L = cfg.n_layers
     rope = _rope_tables(cfg)
     if rng is None:
@@ -436,10 +519,16 @@ def forward_hidden(params: Params, cfg: ModelConfig, tokens: jnp.ndarray, *,
     x = _embed(cfg, params, tokens, positions, emb_rng, deterministic)
 
     def body(carry, layer):
-        p, lrng = layer
+        if lora is None:
+            p, lrng = layer
+            adp = None
+        else:
+            p, lrng, lb = layer
+            adp = _block_adp(lb, lora_scaling)
         r = None if deterministic else lrng
         y, _ = _block(cfg, p, carry, rope, positions, None, None, r,
-                      deterministic, sp_mesh=sp_mesh, sp_inside=sp_inside)
+                      deterministic, sp_mesh=sp_mesh, sp_inside=sp_inside,
+                      adp=adp)
         return y, None
 
     if cfg.use_actv_ckpt:
@@ -467,15 +556,17 @@ def forward_hidden(params: Params, cfg: ModelConfig, tokens: jnp.ndarray, *,
                 "q", "k", "v", "attn_raw_out", "attn_lse", "attn_out",
                 "resid_mid", "up_out", "gate_out"))
 
-    x, _ = jax.lax.scan(body, x, (params["blocks"], layer_rngs),
-                        unroll=_train_scan_unroll(cfg))
+    xs = ((params["blocks"], layer_rngs) if lora is None
+          else (params["blocks"], layer_rngs, lora["blocks"]))
+    x, _ = jax.lax.scan(body, x, xs, unroll=_train_scan_unroll(cfg))
     return _norm(cfg, params["final_norm"], x)
 
 
 def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray, *,
             rng: Optional[jax.Array] = None,
             deterministic: bool = True,
-            sp_mesh=None, sp_inside=None) -> jnp.ndarray:
+            sp_mesh=None, sp_inside=None,
+            lora: Optional[Params] = None, lora_scaling=1.0) -> jnp.ndarray:
     """Training/eval forward over full sequences.
 
     tokens: (B, T) int32.  Returns fp32 logits (B, T, V).
@@ -488,10 +579,11 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray, *,
     """
     x = forward_hidden(params, cfg, tokens, rng=rng,
                        deterministic=deterministic, sp_mesh=sp_mesh,
-                       sp_inside=sp_inside)
-    logits = jnp.einsum("btd,dv->btv", x, params["head"]["weight"],
-                        preferred_element_type=jnp.float32)
-    return logits
+                       sp_inside=sp_inside, lora=lora,
+                       lora_scaling=lora_scaling)
+    return _head_logits(x, params["head"]["weight"],
+                        lora["head"]["weight"] if lora is not None else None,
+                        lora_scaling)
 
 
 # ---------------------------------------------------------------------------
@@ -538,7 +630,10 @@ def unstack_blocks(params: Params, cfg: ModelConfig) -> list:
 
 def forward_with_cache(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                        cache: Params,
-                       blocks_list: Optional[list] = None
+                       blocks_list: Optional[list] = None,
+                       lora: Optional[Params] = None,
+                       lora_scaling=1.0,
+                       lora_blocks_list: Optional[list] = None
                        ) -> Tuple[jnp.ndarray, Params]:
     """Decode forward: process ``tokens`` (B, Tq) given ``cache`` holding
     ``cache['length']`` valid positions; returns (fp32 logits (B, Tq, V),
@@ -566,6 +661,8 @@ def forward_with_cache(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
 
     if blocks_list is None:
         blocks_list = unstack_blocks(params, cfg)
+    if lora is not None and lora_blocks_list is None:
+        lora_blocks_list = unstack_lora_blocks(lora, cfg)
 
     import os as _os
 
@@ -588,9 +685,12 @@ def forward_with_cache(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         use_fused_step = _fds_supports(Tq, Tmax, cfg.head_dim)
 
     new_k, new_v = [], []
-    for p, K, V in zip(blocks_list, cache["k"], cache["v"]):
+    for l, (p, K, V) in enumerate(zip(blocks_list, cache["k"], cache["v"])):
+        adp = (_block_adp(lora_blocks_list[l], lora_scaling)
+               if lora_blocks_list is not None else None)
         h = _norm(cfg, p["norm1"], x)
-        q, k, v = _qkv_proj(cfg, p["attn"], h, rope, positions)
+        q, k, v = _qkv_proj(cfg, p["attn"], h, rope, positions,
+                            adp=adp["attn"] if adp is not None else None)
         if use_fused_step:
             # fused in-place append + attention (ops/decode_step.py): the
             # pallas input_output_aliases declaration is what finally stops
@@ -613,11 +713,14 @@ def forward_with_cache(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                                    kv_length=length + Tq)
         new_k.append(K)
         new_v.append(V)
-        x = x + _attn_out_proj(p["attn"], out, B, Tq)
-        x = x + _mlp(cfg, p["mlp"], _norm(cfg, p["norm2"], x))
+        x = x + _attn_out_proj(p["attn"], out, B, Tq,
+                               adp=adp["attn"] if adp is not None else None)
+        x = x + _mlp(cfg, p["mlp"], _norm(cfg, p["norm2"], x),
+                     adp=adp["mlp"] if adp is not None else None)
     x = _norm(cfg, params["final_norm"], x)
-    logits = jnp.einsum("btd,dv->btv", x, params["head"]["weight"],
-                        preferred_element_type=jnp.float32)
+    logits = _head_logits(x, params["head"]["weight"],
+                          lora["head"]["weight"] if lora is not None
+                          else None, lora_scaling)
     new_cache = {"k": new_k, "v": new_v, "length": length + Tq}
     return logits, new_cache
 
@@ -645,9 +748,29 @@ def init_slot_cache(cfg: ModelConfig, n_slots: int, max_length: int) -> Params:
     }
 
 
+def _slot_adapter_layers(adapter, cfg: ModelConfig):
+    """Gather the batch's per-row adapter matrices from the stacked pool
+    and return (per-layer adp dicts, head node, scales) for the slot
+    loops. ``adapter`` = {"pool": stacked lora tree, "scaling": (N,),
+    "ids": (B,)}; ``None`` -> all-None (exact base path)."""
+    if adapter is None:
+        return None, None, None
+    rows, s = _adapter_rows(adapter["pool"], adapter["scaling"],
+                            adapter["ids"])
+    # rows["blocks"] leaves are (B, L, in, r): slice each layer's view
+    # once, trace-time (the gather itself happened once, above)
+    layers = [
+        _block_adp(jax.tree_util.tree_map(lambda a, l=l: a[:, l],
+                                          rows["blocks"]), s)
+        for l in range(cfg.n_layers)
+    ]
+    return layers, rows["head"]["weight"], s
+
+
 def prefill_into_slot(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                       prompt_len: jnp.ndarray, slot: jnp.ndarray,
-                      cache: Params, blocks_list: Optional[list] = None
+                      cache: Params, blocks_list: Optional[list] = None,
+                      adapter: Optional[Params] = None
                       ) -> Tuple[jnp.ndarray, Params]:
     """Run one request's prompt (``tokens`` (1, Tpb), right-padded to its
     length bucket) and write its k/v panes into row ``slot`` of the slot
@@ -657,6 +780,11 @@ def prefill_into_slot(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     (nothing earlier lives in the slot), with ``kv_length=prompt_len``
     masking the pad keys; the pad positions' k/v land in the cache as
     garbage and stay masked by the engine's per-slot lengths.
+
+    ``adapter``: {"pool", "scaling", "ids" (1,)} — the request's LoRA
+    adapter applied unmerged at every adapted projection (id −1 = base).
+    The prompt's k/v land in the slot ALREADY adapter-transformed, so
+    decode ticks attend to a prefix consistent with the same adapter.
     """
     _, Tpb = tokens.shape
     rope = _rope_tables(cfg)
@@ -664,10 +792,13 @@ def prefill_into_slot(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     x = _embed(cfg, params, tokens, positions, None, True)
     if blocks_list is None:
         blocks_list = unstack_blocks(params, cfg)
+    adp_layers, head_node, head_s = _slot_adapter_layers(adapter, cfg)
     new_k, new_v = [], []
-    for p, K, V in zip(blocks_list, cache["k"], cache["v"]):
+    for l, (p, K, V) in enumerate(zip(blocks_list, cache["k"], cache["v"])):
+        adp = adp_layers[l] if adp_layers is not None else None
         h = _norm(cfg, p["norm1"], x)
-        q, k, v = _qkv_proj(cfg, p["attn"], h, rope, positions)
+        q, k, v = _qkv_proj(cfg, p["attn"], h, rope, positions,
+                            adp=adp["attn"] if adp is not None else None)
         out = causal_attention(q, k, v, q_positions=positions,
                                kv_length=prompt_len)
         # (1, Tpb, Hkv, hd) -> cache-native (1, Hkv, Tpb, hd) pane at
@@ -678,19 +809,59 @@ def prefill_into_slot(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             V, v.transpose(0, 2, 1, 3).astype(V.dtype), (slot, 0, 0, 0))
         new_k.append(K)
         new_v.append(V)
-        x = x + _attn_out_proj(p["attn"], out, 1, Tpb)
-        x = x + _mlp(cfg, p["mlp"], _norm(cfg, p["norm2"], x))
+        x = x + _attn_out_proj(p["attn"], out, 1, Tpb,
+                               adp=adp["attn"] if adp is not None else None)
+        x = x + _mlp(cfg, p["mlp"], _norm(cfg, p["norm2"], x),
+                     adp=adp["mlp"] if adp is not None else None)
     x = _norm(cfg, params["final_norm"], x)
     last = jax.lax.dynamic_slice(x, (0, prompt_len - 1, 0),
                                  (1, 1, x.shape[-1]))
-    logits = jnp.einsum("btd,dv->btv", last, params["head"]["weight"],
-                        preferred_element_type=jnp.float32)
+    logits = _head_logits(last, params["head"]["weight"], head_node, head_s)
     return logits[0, 0], {"k": new_k, "v": new_v}
+
+
+def _use_bgmv(adapter, cfg: ModelConfig) -> bool:
+    """Route per-row adapter deltas through the fused pallas BGMV kernel
+    (ops/decode_step.lora_bgmv). Opt-in via BLLM_BGMV=1 on TPU — like
+    BLLM_FUSED_DECODE, kept off by default until a hardware A/B proves it
+    — and only when EVERY adapted projection's (in, rank, out) is
+    kernel-eligible; the XLA gather+einsum path is the reference."""
+    import os as _os
+
+    if adapter is None or jax.default_backend() != "tpu":
+        return False
+    if _os.environ.get("BLLM_BGMV", "0") != "1":
+        return False
+    from building_llm_from_scratch_tpu.ops.decode_step import (
+        supports_lora_shape,
+    )
+
+    r = adapter["pool"]["blocks"]["attn"]["wq"]["A"].shape[-1]
+    D, F = cfg.emb_dim, cfg.hidden_dim
+    wq, wkv = cfg.n_heads * cfg.head_dim, cfg.n_kv_groups * cfg.head_dim
+    dims = [(D, wq), (D, wkv), (wq, D), (D, F), (F, D)]
+    return all(supports_lora_shape(i, r, o) for i, o in dims)
+
+
+def _bgmv_block_adp(pool_blocks_l, ids, scaling) -> Params:
+    """Per-layer adp dict whose nodes route through the fused kernel:
+    each projection carries its (N, in, r)/(N, r, out) pool panes — the
+    kernel gathers per-row inside, driven by ``ids``."""
+    def node(n):
+        return {"bgmv": (n["A"], n["B"], ids, scaling)}
+
+    out = {}
+    for group in ("attn", "mlp"):
+        out[group] = {name: node(n)
+                      for name, n in pool_blocks_l[group].items()}
+        out[group]["s"] = None
+    return out
 
 
 def decode_slots(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                  lengths: jnp.ndarray, cache: Params,
-                 blocks_list: Optional[list] = None
+                 blocks_list: Optional[list] = None,
+                 adapter: Optional[Params] = None
                  ) -> Tuple[jnp.ndarray, Params]:
     """One decode tick for the whole slot batch: ``tokens`` (S, 1) are each
     slot's last accepted token, ``lengths`` (S,) its valid cache prefix.
@@ -699,6 +870,12 @@ def decode_slots(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     (fp32 logits (S, V), updated cache). Free/finished slots compute
     garbage rows the engine ignores — the shapes never change, so XLA
     compiles exactly one decode program.
+
+    ``adapter``: {"pool", "scaling", "ids" (S,)} — per-SLOT LoRA adapters
+    applied as a batched gather + einsum (BGMV) fused into the existing
+    projections. Adapter identity is a data dimension: any mix of ids
+    (−1 = base model) runs through this same one compiled program, so
+    hot-loading/evicting adapters never recompiles.
     """
     rope = _rope_tables(cfg)
     S = tokens.shape[0]
@@ -717,10 +894,29 @@ def decode_slots(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     use_fused_step = (jax.default_backend() == "tpu"
                       and _fds_supports(1, Tmax, cfg.head_dim))
 
+    if _use_bgmv(adapter, cfg):
+        ids = adapter["ids"].astype(jnp.int32)
+        pool_blocks = adapter["pool"]["blocks"]
+        adp_layers = [
+            _bgmv_block_adp(
+                jax.tree_util.tree_map(lambda a, l=l: a[:, l], pool_blocks),
+                ids, adapter["scaling"])
+            for l in range(cfg.n_layers)
+        ]
+        # head delta stays on the gathered path (vocab width is not
+        # kernel-eligible); the gather is tiny at (S, D, r)/(S, r, V)
+        head_rows, head_s = _adapter_rows(
+            {"head": adapter["pool"]["head"]}, adapter["scaling"], ids)
+        head_node = head_rows["head"]["weight"]
+    else:
+        adp_layers, head_node, head_s = _slot_adapter_layers(adapter, cfg)
+
     new_k, new_v = [], []
-    for p, K, V in zip(blocks_list, cache["k"], cache["v"]):
+    for l, (p, K, V) in enumerate(zip(blocks_list, cache["k"], cache["v"])):
+        adp = adp_layers[l] if adp_layers is not None else None
         h = _norm(cfg, p["norm1"], x)
-        q, k, v = _qkv_proj(cfg, p["attn"], h, rope, positions)
+        q, k, v = _qkv_proj(cfg, p["attn"], h, rope, positions,
+                            adp=adp["attn"] if adp is not None else None)
         if use_fused_step:
             from building_llm_from_scratch_tpu.ops.decode_step import (
                 fused_decode_step,
@@ -735,9 +931,10 @@ def decode_slots(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                                    kv_length=lengths + 1)
         new_k.append(K)
         new_v.append(V)
-        x = x + _attn_out_proj(p["attn"], out, S, 1)
-        x = x + _mlp(cfg, p["mlp"], _norm(cfg, p["norm2"], x))
+        x = x + _attn_out_proj(p["attn"], out, S, 1,
+                               adp=adp["attn"] if adp is not None else None)
+        x = x + _mlp(cfg, p["mlp"], _norm(cfg, p["norm2"], x),
+                     adp=adp["mlp"] if adp is not None else None)
     x = _norm(cfg, params["final_norm"], x)
-    logits = jnp.einsum("btd,dv->btv", x, params["head"]["weight"],
-                        preferred_element_type=jnp.float32)
+    logits = _head_logits(x, params["head"]["weight"], head_node, head_s)
     return logits[:, 0], {"k": new_k, "v": new_v}

@@ -263,6 +263,18 @@ def _prom_name(name: str) -> str:
     return re.sub(r"[^a-zA-Z0-9_:]", "_", name)
 
 
+def _prom_series(prefix: str, name: str, counter: bool = False) -> str:
+    """Series name for one counter/gauge key. A key may carry a label
+    set — ``adapter_requests_finished{adapter="x"}`` — in which case only
+    the metric-name part is sanitized (and the counter ``_total`` suffix
+    lands BEFORE the braces, per exposition-format grammar)."""
+    base, sep, labels = name.partition("{")
+    n = _prom_name(prefix + base)
+    if counter and not n.endswith("_total"):
+        n += "_total"
+    return n + sep + labels
+
+
 def render_prometheus(counters: Dict[str, float],
                       gauges: Dict[str, float],
                       histograms: Dict[str, "Histogram"],
@@ -272,22 +284,28 @@ def render_prometheus(counters: Dict[str, float],
     series follow the ``_bucket{le=}``/``_sum``/``_count`` convention, so
     ``histogram_quantile()`` works on them unmodified."""
     lines = []
+    typed: set = set()
+
+    def emit(name: str, v, kind: str, counter: bool) -> None:
+        n = _prom_series(prefix, name, counter=counter)
+        bare = n.partition("{")[0]
+        # one TYPE line per metric name, even when labeled keys produce
+        # several series of it (exposition-format requirement)
+        if bare not in typed:
+            typed.add(bare)
+            lines.append(f"# TYPE {bare} {kind}")
+        lines.append(f"{n} {v}")
+
     for name in sorted(counters):
         v = counters[name]
         if not isinstance(v, (int, float)):
             continue
-        n = _prom_name(prefix + name)
-        if not n.endswith("_total"):
-            n += "_total"
-        lines.append(f"# TYPE {n} counter")
-        lines.append(f"{n} {v}")
+        emit(name, v, "counter", counter=True)
     for name in sorted(gauges):
         v = gauges[name]
         if not isinstance(v, (int, float)):
             continue
-        n = _prom_name(prefix + name)
-        lines.append(f"# TYPE {n} gauge")
-        lines.append(f"{n} {v}")
+        emit(name, v, "gauge", counter=False)
     for name in sorted(histograms):
         snap = histograms[name].snapshot()
         n = _prom_name(prefix + name)

@@ -166,7 +166,7 @@ _EVENT_LIST: List[EventSpec] = [
     _spec("request_done", required=("request_id",),
           optional=("n_prompt_tokens", "n_tokens", "finish_reason", "slot",
                     "deadline_s", "queue_wait_s", "ttft_s", "tpot_s",
-                    "e2e_s"),
+                    "e2e_s", "adapter"),
           doc="one request completed normally (latency summary)"),
     _spec("request_rejected", required=("request_id", "reason"),
           optional=("queue_depth",),
@@ -179,8 +179,22 @@ _EVENT_LIST: List[EventSpec] = [
           optional=("deadline_s", "queue_wait_s", "queue_depth"),
           doc="deadline passed while queued (TTL shed, HTTP 504)"),
     _spec("request_failed", required=("request_id", "reason"),
-          optional=("error", "slot", "n_tokens"),
+          optional=("error", "slot", "n_tokens", "adapter"),
           doc="one request failed in isolation (or engine death/restart)"),
+    # -- serving: multi-tenant LoRA adapters ------------------------------
+    _spec("adapter_save", required=("path",),
+          optional=("rank", "alpha", "n_params", "fingerprint"),
+          doc="finetuning exported a LoRA adapter artifact "
+              "(--save_adapter)"),
+    _spec("adapter_load", required=("name",),
+          optional=("path", "row", "rank", "alpha", "seconds",
+                    "n_loaded", "capacity"),
+          doc="registry hot-loaded an adapter into a pool row "
+              "(zero recompiles — same pool shapes)"),
+    _spec("adapter_evict", required=("name",),
+          optional=("row", "n_loaded"),
+          doc="registry unloaded an adapter (row reused only once no "
+              "active slot references it)"),
     # -- serving: engine lifecycle ----------------------------------------
     _spec("serve_warmup",
           optional=("n_prefill_buckets", "buckets", "seconds", "n_slots",

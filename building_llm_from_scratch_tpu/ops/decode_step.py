@@ -153,6 +153,81 @@ def fused_decode_step(q, k_new, v_new, k_cache, v_cache, length):
     return out.reshape(B, Tq, Hq, hd), ko, vo
 
 
+def _bgmv_kernel(ids_ref, x_ref, a_ref, b_ref, scale_ref, o_ref, *,
+                 n_pool: int):
+    """One batch row per grid cell: the row's adapter id (scalar-prefetched
+    SMEM) selected WHICH (D, r)/(r, O) pool panes the BlockSpec index maps
+    DMA'd into VMEM; here we just multiply through and scale. id −1 rows
+    fetch the clamped pane but scale by 0 — exact zero delta, no branch."""
+    s = pl.program_id(0)
+    i = ids_ref[s]
+    sc = jnp.where(i >= 0, scale_ref[jnp.clip(i, 0, n_pool - 1)], 0.0)
+    xa = jax.lax.dot(x_ref[0], a_ref[0],
+                     preferred_element_type=jnp.float32)       # (rows, r)
+    o_ref[0] = jax.lax.dot(xa.astype(b_ref.dtype), b_ref[0],
+                           preferred_element_type=jnp.float32) * sc
+
+
+def lora_bgmv(x, a_pool, b_pool, ids, scales, *, interpret=False):
+    """Punica/S-LoRA-style BGMV: per-row gathered LoRA delta, fused.
+
+    x:       (S, D)  one activation row per slot (single-token decode)
+    a_pool:  (N, D, r)  stacked adapter A matrices (N = pool capacity)
+    b_pool:  (N, r, O)
+    ids:     (S,) int32 adapter id per row; −1 = base model (zero delta)
+    scales:  (N,) fp32 alpha/rank per pool row
+
+    Returns (S, O) fp32: ``scales[ids[s]] * (x[s] @ A[ids[s]]) @ B[ids[s]]``.
+
+    Each grid cell DMAs exactly ONE adapter's panes from the pool (the
+    scalar-prefetched ``ids`` drive the BlockSpec index maps), so HBM
+    traffic is O(S · adapter_size), independent of pool capacity — the
+    XLA gather-then-einsum fallback materializes the same gather but
+    cannot skip fetching for id −1 rows. Adapter identity is DATA: any
+    id mix compiles to this one program. TPU-gated via
+    ``supports_lora_shape``; ``interpret=True`` runs the kernel on CPU
+    for parity tests."""
+    S, D = x.shape
+    N, _, r = a_pool.shape
+    O = b_pool.shape[-1]
+    # mosaic wants >= 8 sublanes; one activation row -> pad to 8 zero rows
+    xp = jnp.zeros((S, _MIN_ROWS, D), x.dtype)
+    xp = jax.lax.dynamic_update_slice(xp, x[:, None, :], (0, 0, 0))
+    ids = ids.astype(jnp.int32)
+    scales = scales.astype(jnp.float32)
+
+    def pool_idx(s, ids_ref):
+        return (jnp.clip(ids_ref[s], 0, N - 1), 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(S,),
+        in_specs=[
+            pl.BlockSpec((1, _MIN_ROWS, D), lambda s, ids_ref: (s, 0, 0)),
+            pl.BlockSpec((1, D, r), pool_idx),
+            pl.BlockSpec((1, r, O), pool_idx),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((1, _MIN_ROWS, O),
+                               lambda s, ids_ref: (s, 0, 0)),
+    )
+    out = pl.pallas_call(
+        functools.partial(_bgmv_kernel, n_pool=N),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, _MIN_ROWS, O), jnp.float32),
+        interpret=interpret,
+    )(ids, xp, a_pool, b_pool, scales)
+    return out[:, 0]
+
+
+def supports_lora_shape(D: int, r: int, O: int) -> bool:
+    """BGMV kernel eligibility for one (in=D, rank=r, out=O) projection:
+    lane-aligned in/out dims and a sublane-aligned rank (the r-wide
+    intermediate). Unsupported shapes keep the XLA gather+einsum path —
+    same numbers, just without the per-row pool-pane DMA savings."""
+    return D % 128 == 0 and O % 128 == 0 and r % 8 == 0 and 8 <= r <= 256
+
+
 def supports_shape(Tq: int, Tmax: int, hd: int) -> bool:
     """Kernel eligibility: single-token decode, lane-aligned head dim,
     cache panes that fit VMEM comfortably, and 8-row-aligned Tmax (the

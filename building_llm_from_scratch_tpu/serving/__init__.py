@@ -20,6 +20,11 @@ CLI: ``python -m building_llm_from_scratch_tpu --mode serve ...`` (or the
 installed ``bllm-tpu`` entry point) — see README "Serving".
 """
 
+from building_llm_from_scratch_tpu.serving.adapters import (
+    AdapterMismatchError,
+    AdapterRegistry,
+    AdapterRegistryFullError,
+)
 from building_llm_from_scratch_tpu.serving.engine import DecodeEngine
 from building_llm_from_scratch_tpu.serving.queue import (
     EngineDrainingError,
@@ -39,6 +44,9 @@ from building_llm_from_scratch_tpu.serving.supervisor import (
 )
 
 __all__ = [
+    "AdapterMismatchError",
+    "AdapterRegistry",
+    "AdapterRegistryFullError",
     "DecodeEngine",
     "EngineDrainingError",
     "EngineSupervisor",

@@ -1,0 +1,342 @@
+"""Multi-tenant LoRA adapter registry for the serving engine.
+
+The serving gap this closes: ``models/lora.py`` applies adapters by
+MERGING — ``W' = W + s·A@B`` — which is one weight copy per adapter and
+therefore one tenant per engine. The registry instead keeps adapters
+device-resident in a STACKED pool:
+
+    pool leaf shapes = (n_adapters_max, ...adapter leaf...)
+    scaling          = (n_adapters_max,) fp32  (alpha/rank per row)
+
+Adapter COUNT is a static capacity baked into the compiled programs;
+adapter IDENTITY is a data dimension (per-slot ``adapter_id`` arrays flow
+into the engine's prefill/decode programs, id −1 = base model). So:
+
+  - any mix of adapters + base traffic decodes in the engine's ONE
+    compiled decode program (CompileWatcher-asserted in tests/CI);
+  - hot-loading an adapter is a functional ``pool.at[row].set(...)`` —
+    new device arrays, same shapes, ZERO recompiles;
+  - evicting frees the name/row immediately but NEVER zeroes the pool
+    row: an in-flight request keeps decoding against the weights it was
+    admitted with, and the row is only reused once no active slot
+    references it (the engine's in-use probe).
+
+Artifacts come from finetuning's ``--save_adapter`` (models/lora.py npz
+format: A/B tree + rank/alpha/base-config fingerprint). The registry
+refuses artifacts whose fingerprint mismatches its base model — a LoRA
+delta against different base weights is silent garbage, not an error
+XLA would ever raise.
+
+Concurrency contract (mirrors the engine's lock discipline): mutations
+(``load``/``evict``) serialize on the registry lock; the engine-side
+reads (``lookup`` per admission, ``pool_args`` per tick) are LOCK-FREE
+snapshot reads of copy-on-write references — the tick path never takes
+the registry lock, so a slow artifact load cannot stall decode, and the
+load -> engine-lock (in-use probe) edge cannot deadlock against the
+tick's engine-lock -> registry reads.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from building_llm_from_scratch_tpu.configs import ModelConfig
+from building_llm_from_scratch_tpu.models.lora import (
+    adapter_fingerprint,
+    init_lora_params,
+    load_adapter,
+)
+from building_llm_from_scratch_tpu.obs.metrics import get_metrics
+from building_llm_from_scratch_tpu.utils.logging import setup_logger
+
+logger = setup_logger(__name__)
+
+Params = Dict[str, Any]
+
+#: adapter name the telemetry uses for un-adapted (base-model) requests
+BASE_ADAPTER = "base"
+
+#: legal adapter names: these flow verbatim into Prometheus label values
+#: and log lines — quotes/braces/backslashes/whitespace would corrupt the
+#: whole /metrics exposition, so they are refused at load time (the one
+#: gate every served name passes through)
+_NAME_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9_.:-]*$")
+
+
+class AdapterRegistryFullError(RuntimeError):
+    """No free pool row: every row is loaded or still referenced by an
+    active slot. Raise capacity (``--serve_adapter_slots``) or evict."""
+
+
+class AdapterMismatchError(ValueError):
+    """Artifact's base-config fingerprint does not match the loaded
+    model — the A/B deltas would multiply against the wrong weights."""
+
+
+def _leaf_pad_axis(path) -> int:
+    """Which axis of an adapter leaf is the RANK axis: A leaves are
+    (..., in, r) — last; B leaves are (..., r, out) — second-to-last."""
+    name = path[-1].key
+    return -1 if name == "A" else -2
+
+
+class AdapterRegistry:
+    """Device-resident stacked pool of LoRA adapters, hot-load/evictable
+    under live traffic.
+
+    Build one per engine (same ``cfg``/``params`` base), load artifacts,
+    then hand it to ``DecodeEngine(..., adapters=registry)``:
+
+        reg = AdapterRegistry(cfg, params, capacity=8, max_rank=16)
+        reg.load("tenant-a", "adapters/a.npz")
+        engine = DecodeEngine(cfg, params, tok, adapters=reg)
+        engine.submit(prompt, SamplingParams(adapter="tenant-a"))
+
+    ``capacity`` and ``max_rank`` are STATIC (they size the pool the
+    compiled programs close over); lower-rank artifacts zero-pad up to
+    ``max_rank`` — zero columns/rows contribute an exactly-zero delta.
+    """
+
+    def __init__(self, cfg: ModelConfig, params: Params, *,
+                 capacity: int = 8, max_rank: int = 16):
+        import jax
+        import jax.numpy as jnp
+
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        if max_rank < 1:
+            raise ValueError("max_rank must be >= 1")
+        self.cfg = cfg
+        self.capacity = int(capacity)
+        self.max_rank = int(max_rank)
+        self.fingerprint = adapter_fingerprint(cfg)
+        # template defines the pool's tree structure + leaf shapes; the
+        # random A init is discarded (rows start zero)
+        template = init_lora_params(cfg, params, jax.random.PRNGKey(0),
+                                    rank=self.max_rank)
+        self._paths = {
+            tuple(p.key for p in path)
+            for path, _ in jax.tree_util.tree_flatten_with_path(template)[0]
+        }
+        pool = jax.tree_util.tree_map(
+            lambda a: jnp.zeros((self.capacity,) + a.shape, a.dtype),
+            template)
+        self._lock = threading.Lock()
+        # (pool, scaling) swapped as ONE tuple: lock-free readers see a
+        # consistent pair. Mutations replace, never write in place.
+        self._device: Tuple[Params, Any] = (
+            pool, jnp.zeros((self.capacity,), jnp.float32)
+        )                                   # guarded-by: _lock [writes]
+        self._by_name: Dict[str, int] = {}  # guarded-by: _lock [writes]
+        self._meta: Dict[str, dict] = {}    # guarded-by: _lock [writes]
+        self._rows: List[Optional[str]] = (
+            [None] * self.capacity)         # guarded-by: _lock
+        self._in_use_probe: Optional[Callable[[], Set[int]]] = None
+        self.n_loads = 0                    # guarded-by: _lock
+        self.n_evicts = 0                   # guarded-by: _lock
+
+    @classmethod
+    def from_artifacts(cls, cfg: ModelConfig, params: Params,
+                       specs: Dict[str, str], *,
+                       capacity: int = 0,
+                       max_rank: int = 0) -> "AdapterRegistry":
+        """Build + load a registry from {name: artifact_path}. With
+        ``capacity=0`` leave one spare row of hot-load headroom; with
+        ``max_rank=0`` size the rank to the largest artifact. Each
+        artifact is parsed ONCE (meta sizes the pool, then the same
+        parse installs)."""
+        parsed = {name: (path, load_adapter(path))
+                  for name, path in specs.items()}
+        if not max_rank:
+            max_rank = max((meta["rank"] for _p, (_l, meta)
+                            in parsed.values()), default=8)
+        if not capacity:
+            capacity = max(2, len(specs) + 1)
+        reg = cls(cfg, params, capacity=capacity, max_rank=max_rank)
+        for name, (path, (lora, meta)) in parsed.items():
+            reg._install(name, path, lora, meta, time.monotonic())
+        return reg
+
+    # -- engine-side reads (lock-free snapshots; see module docstring) ----
+
+    def pool_args(self) -> Tuple[Params, Any]:
+        """(stacked pool tree, (capacity,) scaling) — the per-call device
+        arguments the engine threads into its compiled programs. One
+        atomic tuple read; called every tick."""
+        return self._device
+
+    def lookup(self, name: str) -> Optional[int]:
+        """Pool row for ``name``; None when not loaded (engine fails the
+        request with reason ``adapter_not_loaded``). Called per admission."""
+        return self._by_name.get(name)
+
+    def resolve(self, name: str) -> int:
+        """Like ``lookup`` but raising — the submit-time rejection path."""
+        row = self._by_name.get(name)
+        if row is None:
+            raise KeyError(
+                f"adapter '{name}' is not loaded (loaded: "
+                f"{sorted(self._by_name) or 'none'})")
+        return row
+
+    def names(self) -> List[str]:
+        return sorted(self._by_name)
+
+    @property
+    def n_loaded(self) -> int:
+        return len(self._by_name)
+
+    # -- engine wiring -----------------------------------------------------
+
+    def set_in_use_probe(self, fn: Callable[[], Set[int]]) -> None:
+        """The engine's view of which pool rows active slots reference —
+        ``load`` will not reuse those rows even after an evict, so
+        hot-load/evict never corrupts an in-flight request's weights."""
+        self._in_use_probe = fn
+
+    def _rows_in_use(self) -> Set[int]:
+        if self._in_use_probe is None:
+            return set()
+        try:
+            return set(self._in_use_probe())
+        except Exception:           # noqa: BLE001 — a wedged engine must
+            # not block registry admin; worst case we skip reusing a row
+            return set(range(self.capacity))
+
+    # -- mutations ---------------------------------------------------------
+
+    def load(self, name: str, path: str) -> int:
+        """Load one artifact into a free pool row; returns the row id.
+
+        Fingerprint-checked against the registry's base model; rank
+        zero-padded to ``max_rank``. The pool update is functional
+        (``at[row].set``) — same shapes, so the engine's frozen compiled
+        programs accept the new arrays with zero recompiles."""
+        t0 = time.monotonic()
+        lora, meta = load_adapter(path)
+        return self._install(name, path, lora, meta, t0)
+
+    def _install(self, name: str, path: str, lora: Params, meta: dict,
+                 t0: float) -> int:
+        """Validate + write one already-parsed artifact into the pool."""
+        import jax
+        import jax.numpy as jnp
+
+        if not _NAME_RE.match(name):
+            raise ValueError(
+                f"adapter name '{name}' is invalid: names flow into "
+                "metrics labels and must match "
+                "[A-Za-z0-9][A-Za-z0-9_.:-]*")
+        if name == BASE_ADAPTER:
+            raise ValueError(
+                f"adapter name '{BASE_ADAPTER}' is reserved: it is the "
+                "telemetry bucket for un-adapted (base-model) traffic")
+        if meta["fingerprint"] != self.fingerprint:
+            raise AdapterMismatchError(
+                f"adapter '{name}' ({path}) was trained against base "
+                f"config {meta.get('model')}/{meta['fingerprint']}, but "
+                f"this registry serves {self.cfg.name}/{self.fingerprint}")
+        rank = int(meta["rank"])
+        if rank > self.max_rank:
+            raise ValueError(
+                f"adapter '{name}' rank {rank} exceeds the pool's static "
+                f"max_rank {self.max_rank} (rebuild the registry larger)")
+        flat = jax.tree_util.tree_flatten_with_path(lora)[0]
+        got = {tuple(p.key for p in path) for path, _ in flat}
+        if got != self._paths:
+            missing = sorted(".".join(p) for p in self._paths - got)
+            extra = sorted(".".join(p) for p in got - self._paths)
+            raise ValueError(
+                f"adapter '{name}' tree mismatch: missing {missing}, "
+                f"unexpected {extra}")
+        with self._lock:
+            if name in self._by_name:
+                raise ValueError(f"adapter '{name}' is already loaded "
+                                 "(evict it first to replace)")
+            in_use = self._rows_in_use()
+            row = next((r for r in range(self.capacity)
+                        if self._rows[r] is None and r not in in_use), None)
+            if row is None:
+                raise AdapterRegistryFullError(
+                    f"no free adapter row: {self.n_loaded}/{self.capacity} "
+                    f"loaded, {sorted(in_use)} still referenced by active "
+                    "slots")
+
+            def write_row(pool_leaf, path_leaf):
+                path, leaf = path_leaf
+                pad_axis = _leaf_pad_axis(path) % leaf.ndim
+                pads = [(0, 0)] * leaf.ndim
+                pads[pad_axis] = (0, self.max_rank - rank)
+                padded = np.pad(np.asarray(leaf), pads)
+                return pool_leaf.at[row].set(
+                    jnp.asarray(padded, pool_leaf.dtype))
+
+            pool, scaling = self._device
+            flat_pool, treedef = jax.tree_util.tree_flatten(pool)
+            # flatten orders match: both trees share the template paths
+            new_pool = jax.tree_util.tree_unflatten(
+                treedef, [write_row(pl, fl)
+                          for pl, fl in zip(flat_pool, flat)])
+            new_scaling = scaling.at[row].set(
+                float(meta["alpha"]) / float(rank))
+            self._device = (new_pool, new_scaling)
+            self._rows[row] = name
+            self._by_name = {**self._by_name, name: row}
+            self._meta = {**self._meta, name: meta}
+            self.n_loads += 1
+            n_loaded = self.n_loaded
+        get_metrics().event(
+            "adapter_load", name=name, path=path, row=row, rank=rank,
+            alpha=float(meta["alpha"]), n_loaded=n_loaded,
+            capacity=self.capacity,
+            seconds=round(time.monotonic() - t0, 4))
+        logger.info("Adapter '%s' loaded into row %d (rank %d, %d/%d).",
+                    name, row, rank, n_loaded, self.capacity)
+        return row
+
+    def evict(self, name: str) -> int:
+        """Unload ``name``: new submits for it are rejected immediately;
+        the pool row's weights stay in place until every active slot
+        referencing it retires (in-use probe guards reuse), so in-flight
+        requests finish untouched. Returns the freed row."""
+        with self._lock:
+            row = self._by_name.get(name)
+            if row is None:
+                raise KeyError(f"adapter '{name}' is not loaded")
+            self._rows[row] = None
+            by = dict(self._by_name)
+            del by[name]
+            self._by_name = by
+            meta = dict(self._meta)
+            meta.pop(name, None)
+            self._meta = meta
+            self.n_evicts += 1
+            n_loaded = self.n_loaded
+        get_metrics().event("adapter_evict", name=name, row=row,
+                            n_loaded=n_loaded)
+        logger.info("Adapter '%s' evicted from row %d (%d loaded).",
+                    name, row, n_loaded)
+        return row
+
+    # -- introspection -----------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "capacity": self.capacity,
+                "max_rank": self.max_rank,
+                "n_loaded": self.n_loaded,
+                "n_loads": self.n_loads,
+                "n_evicts": self.n_evicts,
+                "adapters": {
+                    name: {"row": row,
+                           "rank": self._meta[name]["rank"],
+                           "alpha": self._meta[name]["alpha"]}
+                    for name, row in sorted(self._by_name.items())
+                },
+            }

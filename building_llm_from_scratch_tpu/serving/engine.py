@@ -74,6 +74,7 @@ from building_llm_from_scratch_tpu.obs.metrics import (
     render_prometheus,
 )
 from building_llm_from_scratch_tpu.obs.schema import TICK_PHASES
+from building_llm_from_scratch_tpu.serving.adapters import BASE_ADAPTER
 from building_llm_from_scratch_tpu.serving.queue import (
     EngineDrainingError,
     QueueFullError,
@@ -126,12 +127,18 @@ class DecodeEngine:
                  default_deadline_s: Optional[float] = None,
                  tick_timeout_s: float = 0.0, max_restarts: int = 3,
                  restart_backoff_s: float = 0.5,
-                 hooks: Optional[FaultHooks] = None):
+                 hooks: Optional[FaultHooks] = None,
+                 adapters=None):
         import jax
 
         self.cfg = cfg
         self.params = params
         self.tokenizer = tokenizer
+        #: serving/adapters.AdapterRegistry (or None = base model only).
+        #: The stacked pool + per-slot adapter ids become per-call data
+        #: arguments of the compiled programs — multi-tenant traffic
+        #: keeps the ONE-decode-program invariant.
+        self.adapters = adapters
         self.n_slots = int(n_slots)
         self.max_len = min(int(max_len or cfg.context_length),
                            cfg.context_length)
@@ -166,6 +173,16 @@ class DecodeEngine:
             (S,) + probe_key.shape, probe_key.dtype)    # guarded-by: _lock
         self._temps = np.zeros((S,), np.float32)        # guarded-by: _lock
         self._topks = np.zeros((S,), np.int32)          # guarded-by: _lock
+        # per-slot adapter pool row; −1 = base model (exact zero delta)
+        self._adapter_ids = np.full((S,), -1, np.int32)  # guarded-by: _lock
+        # per-adapter request accounting ("base" for un-adapted traffic):
+        # name -> {finished, failed, tokens} — feeds the labeled /metrics
+        # series and serve_summary
+        self._adapter_counts = {}                        # guarded-by: _lock
+        if self.adapters is not None:
+            # the registry's load() must not reuse a pool row an active
+            # slot still decodes against (hot-evict-then-load safety)
+            self.adapters.set_in_use_probe(self._adapter_rows_in_use)
 
         # donate the cache panes: the caller always rebinds self.cache to
         # the outputs, so XLA may alias input->output and the pallas
@@ -244,12 +261,17 @@ class DecodeEngine:
     # signatures carry only the small mutable state + caches) -------------
 
     def _prefill_impl(self, cache_k, cache_v, tokens, prompt_len, slot,
-                      base_key, temp, topk):
+                      base_key, temp, topk, pool=None, pool_scale=None,
+                      adapter_id=None):
         import jax.numpy as jnp
 
+        adapter = None
+        if pool is not None:
+            adapter = {"pool": pool, "scaling": pool_scale,
+                       "ids": jnp.reshape(adapter_id, (1,))}
         logits, cache = prefill_into_slot(
             self.params, self.cfg, tokens, prompt_len, slot,
-            {"k": cache_k, "v": cache_v}, self._blocks)
+            {"k": cache_k, "v": cache_v}, self._blocks, adapter=adapter)
         key0 = token_rng(base_key, 0)
         tok = sample_tokens_dynamic(
             logits[None], key0[None], jnp.reshape(temp, (1,)),
@@ -261,13 +283,18 @@ class DecodeEngine:
         return tok, ok, cache["k"], cache["v"]
 
     def _decode_impl(self, cache_k, cache_v, tokens, lengths, base_keys,
-                     n_gen, temps, topks):
+                     n_gen, temps, topks, pool=None, pool_scale=None,
+                     adapter_ids=None):
         import jax
         import jax.numpy as jnp
 
+        adapter = None
+        if pool is not None:
+            adapter = {"pool": pool, "scaling": pool_scale,
+                       "ids": adapter_ids}
         logits, cache = decode_slots(
             self.params, self.cfg, tokens[:, None], lengths,
-            {"k": cache_k, "v": cache_v}, self._blocks)
+            {"k": cache_k, "v": cache_v}, self._blocks, adapter=adapter)
         keys = jax.vmap(token_rng)(base_keys, n_gen)
         nxt = sample_tokens_dynamic(logits, keys, temps, topks,
                                     self.max_top_k)
@@ -276,6 +303,41 @@ class DecodeEngine:
         # retires just that slot (reason non_finite_logits)
         ok = jnp.all(jnp.isfinite(logits), axis=-1)
         return nxt, ok, cache["k"], cache["v"]
+
+    def _pool_args(self) -> tuple:
+        """Positional tail for the compiled programs: the registry's
+        CURRENT stacked pool + scaling (lock-free snapshot — hot-loads
+        swap these device arrays between ticks, same shapes, zero
+        recompiles). Empty when no registry is attached, keeping the
+        registry-less engine's historical call signature."""
+        if self.adapters is None:
+            return ()
+        pool, scale = self.adapters.pool_args()
+        return (pool, scale)
+
+    def _pool_args_for(self, adapter_row) -> tuple:
+        """Prefill's positional tail: pool + scaling + THIS request's row."""
+        base = self._pool_args()
+        return base + (adapter_row,) if base else ()
+
+    def _adapter_rows_in_use(self):
+        """Registry in-use probe: pool rows active slots reference. TIMED
+        lock acquire — a wedged (or just slow) tick must not hang registry
+        admin. On timeout the answer must be CONSERVATIVE: an in-flight
+        ``_admit`` may have resolved a row but not yet committed it to
+        ``_adapter_ids``, so a lock-free read could green-light reusing a
+        row a just-admitted request is about to decode against (silent
+        cross-tenant weight corruption). Report every row in use instead —
+        a hot-load during a wedge waits or fails loudly, never corrupts."""
+        lock = self._lock
+        locked = lock.acquire(timeout=1.0)
+        try:
+            if not locked:
+                return set(range(self.adapters.capacity))
+            return {int(r) for r in self._adapter_ids if r >= 0}
+        finally:
+            if locked:
+                lock.release()
 
     # -- admission --------------------------------------------------------
 
@@ -366,6 +428,21 @@ class DecodeEngine:
             raise ValueError(
                 f"top_k={params.top_k} outside this engine's compiled "
                 f"capacity 1..{self.max_top_k} (raise max_top_k)")
+        if params.adapter is not None:
+            # unknown adapters are poison at admission (the slot would
+            # decode base-model garbage under the tenant's name) — reject
+            # at submit (HTTP 400). Re-resolved at admit: a concurrent
+            # evict between here and admission fails just that request.
+            if self.adapters is None:
+                raise ValueError(
+                    f"request names adapter '{params.adapter}' but this "
+                    "engine has no adapter registry (--serve_adapters)")
+            try:
+                self.adapters.resolve(params.adapter)
+            except KeyError as e:
+                # e.args[0], not str(e): KeyError.__str__ reprs its
+                # message, which would wrap the 400 body in quotes
+                raise ValueError(e.args[0]) from None
         total = int(ids.size) + params.max_new_tokens
         if total > self.max_len:
             raise ValueError(
@@ -568,6 +645,21 @@ class DecodeEngine:
         base_key = jax.device_get(_prng_key(req.params.seed))
         temp = np.float32(req.params.temperature)
         topk = np.int32(req.params.top_k or 0)
+        adapter_row = np.int32(-1)
+        if req.params.adapter is not None:
+            # re-resolve by NAME at admission: submit's check only gates
+            # entry — a hot evict (or evict+reload into another row)
+            # while the request sat queued must bind the CURRENT row, or
+            # fail this one request in isolation, never serve stale rows
+            row = (self.adapters.lookup(req.params.adapter)
+                   if self.adapters is not None else None)
+            if row is None:
+                self._fail_request(
+                    slot, req,
+                    f"adapter '{req.params.adapter}' evicted while queued",
+                    reason="adapter_not_loaded")
+                return
+            adapter_row = np.int32(row)
         try:
             self.hooks.before_prefill(req)
         except Exception as e:  # noqa: BLE001 — poison request, isolate
@@ -583,7 +675,8 @@ class DecodeEngine:
         t_pf = time.perf_counter()
         tok, ok, k, v = self._prefill(self.cache["k"], self.cache["v"],
                                       padded, np.int32(Tp), np.int32(slot),
-                                      base_key, temp, topk)
+                                      base_key, temp, topk,
+                                      *self._pool_args_for(adapter_row))
         if self._generation != gen:
             return          # abandoned mid-prefill: commit nothing
         self.cache = {"k": k, "v": v}
@@ -595,6 +688,7 @@ class DecodeEngine:
         self._base_keys[slot] = base_key
         self._temps[slot] = temp
         self._topks[slot] = topk
+        self._adapter_ids[slot] = adapter_row
         if self.hooks.poison_nan(req):
             self._poison_slot_cache(slot)      # fault injection (tests)
         # explicit fetch; blocks until prefill ran
@@ -719,7 +813,8 @@ class DecodeEngine:
             nxt, ok, k, v = self._decode(
                 self.cache["k"], self.cache["v"], self._last_tokens,
                 self._lengths, self._base_keys, self._n_gen, self._temps,
-                self._topks)
+                self._topks, *(self._pool_args() + (self._adapter_ids,)
+                               if self.adapters is not None else ()))
             self._tick_add("decode_dispatch", time.perf_counter() - t_dec)
             if self._generation != gen:
                 self._book_tick_wall(t_tick0)
@@ -846,6 +941,17 @@ class DecodeEngine:
         self._n_gen[slot] = 0
         self._temps[slot] = 0.0
         self._topks[slot] = 0
+        self._adapter_ids[slot] = -1
+
+    # holds: _lock
+    def _count_adapter(self, req: Request, outcome: str) -> None:
+        """Per-adapter request accounting (name "base" for un-adapted
+        traffic): feeds the labeled /metrics series + serve_summary."""
+        name = req.params.adapter or BASE_ADAPTER
+        c = self._adapter_counts.setdefault(
+            name, {"finished": 0, "failed": 0, "tokens": 0})
+        c[outcome] += 1
+        c["tokens"] += len(req.output_ids)
 
     # holds: _lock
     def _fail_request(self, slot: Optional[int], req: Request, msg: str,
@@ -861,6 +967,7 @@ class DecodeEngine:
         req.state = FINISHED
         req.t_finish = time.monotonic()
         self.requests_failed += 1
+        self._count_adapter(req, "failed")
         if req.params.deadline_s is not None and finish != FINISH_CANCELLED:
             # a failure is an SLO miss — except a client cancellation,
             # which is the CLIENT giving up; counting it would let
@@ -869,7 +976,8 @@ class DecodeEngine:
             self.slo_window.observe(miss=True)
         get_metrics().event("request_failed", request_id=req.id,
                             reason=reason, error=msg, slot=slot,
-                            n_tokens=len(req.output_ids))
+                            n_tokens=len(req.output_ids),
+                            adapter=req.params.adapter)
         self._emit_span(req)
         logger.warning("Request %d failed (%s): %s", req.id, reason, msg)
         req._mark_done()
@@ -887,6 +995,7 @@ class DecodeEngine:
         if self.scheduler.slots[slot] is req:  # not reassigned by restart
             self._free_slot(slot)
         self.requests_finished += 1
+        self._count_adapter(req, "finished")
         self._observe_service_time(req)
         for hist, val in ((self.ttft_hist, req.ttft_s()),
                           (self.tpot_hist, req.tpot_s()),
@@ -961,16 +1070,23 @@ class DecodeEngine:
         with self._lock:
             buckets = self.prompt_buckets()
             zero_key = np.zeros_like(self._base_keys[0])
+            # warm WITH the adapter-pool argument tail when a registry is
+            # attached (id −1 = base): the adapter graph is part of THE
+            # one decode program, so later adapter traffic — and every
+            # hot-load, which swaps same-shaped pool arrays — hits the
+            # frozen signature exactly
             for Tpb in buckets:
                 dummy = np.zeros((1, Tpb), np.int32)
                 tok, _ok, k, v = self._prefill(
                     self.cache["k"], self.cache["v"], dummy, np.int32(1),
-                    np.int32(0), zero_key, np.float32(0.0), np.int32(0))
+                    np.int32(0), zero_key, np.float32(0.0), np.int32(0),
+                    *self._pool_args_for(np.int32(-1)))
                 self.cache = {"k": k, "v": v}
             nxt, _ok, k, v = self._decode(
                 self.cache["k"], self.cache["v"], self._last_tokens,
                 self._lengths, self._base_keys, self._n_gen, self._temps,
-                self._topks)
+                self._topks, *(self._pool_args() + (self._adapter_ids,)
+                               if self.adapters is not None else ()))
             self.cache = {"k": k, "v": v}
             jax.device_get(nxt)               # block until compiled + ran
             if isinstance(self._prefill, CompileWatcher):
@@ -979,6 +1095,7 @@ class DecodeEngine:
             self._lengths[:] = 0
             self._last_tokens[:] = 0
             self._n_gen[:] = 0
+            self._adapter_ids[:] = -1
             # re-anchor the metrics window: the first cadence row should
             # describe serving, not a window stretched over compile time
             self._window_t0 = time.monotonic()
@@ -1090,6 +1207,7 @@ class DecodeEngine:
                 self._n_gen[:] = 0
                 self._temps[:] = 0.0
                 self._topks[:] = 0
+                self._adapter_ids[:] = -1
                 # the old cache may be donation-poisoned or numerically
                 # corrupt; a fresh one has identical shapes/dtypes, so the
                 # frozen compiled programs accept it without recompiling
@@ -1330,6 +1448,12 @@ class DecodeEngine:
                 "n_restarts": self.n_restarts,
                 "draining": self._draining,
             }
+            if self._adapter_counts:
+                out["per_adapter"] = {
+                    nm: dict(c)
+                    for nm, c in sorted(self._adapter_counts.items())}
+            if self.adapters is not None:
+                out["adapters_loaded"] = self.adapters.n_loaded
             slo = self.slo_window.ratio()
             if slo is not None:
                 out["slo_miss_ratio"] = round(slo, 6)
@@ -1373,6 +1497,18 @@ class DecodeEngine:
             for ph in TICK_PHASES:
                 counters[f"tick_{ph}_seconds"] = round(
                     self.tick_phase_totals[ph], 6)
+            # per-adapter labeled series (multi-tenant accounting): one
+            # requests/tokens counter triple per adapter name seen, plus
+            # a live per-adapter slot-occupancy gauge
+            adapter_active: dict = {}
+            for _slot, _req in self.scheduler.active():
+                nm = _req.params.adapter or BASE_ADAPTER
+                adapter_active[nm] = adapter_active.get(nm, 0) + 1
+            for nm, c in sorted(self._adapter_counts.items()):
+                lbl = f'{{adapter="{nm}"}}'
+                counters[f"adapter_requests_finished{lbl}"] = c["finished"]
+                counters[f"adapter_requests_failed{lbl}"] = c["failed"]
+                counters[f"adapter_tokens_generated{lbl}"] = c["tokens"]
             gauges = {
                 "slot_occupancy": self.scheduler.occupancy(),
                 "slots_active": self.scheduler.n_active,
@@ -1383,6 +1519,11 @@ class DecodeEngine:
                 "engine_up": 0.0 if self._dead is not None else 1.0,
                 "uptime_seconds": round(self.uptime_s(), 3),
             }
+            for nm, n_act in sorted(adapter_active.items()):
+                gauges[f'adapter_slots_active{{adapter="{nm}"}}'] = n_act
+            if self.adapters is not None:
+                gauges["adapters_loaded"] = self.adapters.n_loaded
+                gauges["adapter_capacity"] = self.adapters.capacity
             # always exported: a scrape gap (series absent until the
             # first deadline-carrying request) reads as "no data" on a
             # dashboard when the truth is "no misses"

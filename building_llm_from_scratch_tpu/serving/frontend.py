@@ -53,6 +53,31 @@ from building_llm_from_scratch_tpu.utils.logging import setup_logger
 logger = setup_logger(__name__)
 
 
+def parse_adapter_specs(spec: str) -> dict:
+    """``--serve_adapters`` value -> {name: artifact_path}. Format:
+    comma-separated ``name=path`` pairs; names must be unique."""
+    out: dict = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(
+                f"--serve_adapters entry '{part}' is not name=path")
+        name, path = part.split("=", 1)
+        name, path = name.strip(), path.strip()
+        if not name or not path:
+            raise ValueError(
+                f"--serve_adapters entry '{part}' is not name=path")
+        if name in out:
+            raise ValueError(f"--serve_adapters names adapter '{name}' "
+                             "twice")
+        out[name] = path
+    if not out:
+        raise ValueError("--serve_adapters is empty")
+    return out
+
+
 def params_from_record(rec: dict, default_max_new: int) -> SamplingParams:
     return SamplingParams(
         max_new_tokens=int(rec.get("max_new_tokens", default_max_new)),
@@ -67,6 +92,10 @@ def params_from_record(rec: dict, default_max_new: int) -> SamplingParams:
         # not be silently promoted to "no deadline"
         deadline_s=(float(rec["deadline_s"])
                     if rec.get("deadline_s") is not None else None),
+        # LoRA adapter by registry name; unknown names reject at submit
+        # (ValueError -> HTTP 400)
+        adapter=(str(rec["adapter"])
+                 if rec.get("adapter") is not None else None),
     )
 
 
@@ -330,6 +359,23 @@ def run_serve(args, comps, metric_logger) -> DecodeEngine:
         GracefulStopper,
     )
 
+    adapters = None
+    if getattr(args, "serve_adapters", None):
+        # --serve_adapters name=path[,name=path...]: build the multi-
+        # tenant LoRA registry before the engine compiles (the pool's
+        # static capacity/rank are baked into the decode program)
+        from building_llm_from_scratch_tpu.serving.adapters import (
+            AdapterRegistry,
+        )
+
+        specs = parse_adapter_specs(args.serve_adapters)
+        adapters = AdapterRegistry.from_artifacts(
+            comps.cfg, comps.params, specs,
+            capacity=args.serve_adapter_slots)
+        logger.info("Adapter registry: %d adapter(s) loaded (%s), "
+                    "capacity %d.", adapters.n_loaded,
+                    ", ".join(adapters.names()), adapters.capacity)
+
     engine = DecodeEngine(
         comps.cfg, comps.params, comps.tokenizer,
         n_slots=args.serve_slots,
@@ -341,6 +387,7 @@ def run_serve(args, comps, metric_logger) -> DecodeEngine:
         tick_timeout_s=args.serve_tick_timeout,
         max_restarts=args.serve_max_restarts,
         metrics_every=args.serve_metrics_every,
+        adapters=adapters,
     )
     stall = None
     if args.stall_timeout > 0 and engine.supervisor is None:

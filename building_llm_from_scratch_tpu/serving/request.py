@@ -57,6 +57,12 @@ class SamplingParams:
     it from the queue instead of decoding into the void (and rejects at
     submit time when the queue is already predicted to blow the deadline).
     ``None`` = no deadline (the engine may apply its default).
+
+    ``adapter`` names a LoRA adapter in the engine's ``AdapterRegistry``
+    (serving/adapters.py): the request decodes through base weights + that
+    adapter's delta, co-batched with any other adapters' traffic in the
+    same compiled program. ``None`` = the base model. Unknown names are
+    rejected at submit (HTTP 400).
     """
 
     max_new_tokens: int = 128
@@ -66,6 +72,7 @@ class SamplingParams:
     eos_id: Optional[int] = None
     ignore_eos: bool = False
     deadline_s: Optional[float] = None
+    adapter: Optional[str] = None
 
 
 class Request:
@@ -182,6 +189,8 @@ class Request:
         }
         if self.params.deadline_s is not None:
             out["deadline_s"] = self.params.deadline_s
+        if self.params.adapter is not None:
+            out["adapter"] = self.params.adapter
         for name, fn in (("queue_wait_s", self.queue_wait_s),
                          ("ttft_s", self.ttft_s), ("tpot_s", self.tpot_s),
                          ("e2e_s", self.e2e_s)):
@@ -238,6 +247,8 @@ class Request:
         }
         if self.slot is not None:
             row["slot"] = self.slot
+        if self.params.adapter is not None:
+            row["adapter"] = self.params.adapter
         if self.error is not None:
             row["error"] = self.error
         return row

@@ -497,11 +497,25 @@ class Trainer:
                                   max_new_tokens: int = 50) -> str:
         ids = text_to_token_ids(start_context, self.tokenizer)
         ids = ids[:, -self.cfg.context_length:]
-        out = generate(self._full_params(), self.cfg, ids,
-                       max_new_tokens=max_new_tokens,
-                       context_size=self.cfg.context_length,
-                       eos_id=self.cfg.eos_id,
-                       rng=jax.random.PRNGKey(self.global_step))
+        if self.use_lora:
+            # merge-free sampling (models/lora.apply_lora): the adapter
+            # delta rides the projections unmerged — the same path the
+            # multi-tenant serving engine decodes with, and no per-sample
+            # merged-weight materialization of the full model
+            out = generate(self.state["frozen"], self.cfg, ids,
+                           max_new_tokens=max_new_tokens,
+                           context_size=self.cfg.context_length,
+                           eos_id=self.cfg.eos_id,
+                           rng=jax.random.PRNGKey(self.global_step),
+                           lora=self.state["trainable"],
+                           lora_alpha=self.lora_alpha,
+                           lora_rank=self.lora_rank)
+        else:
+            out = generate(self._full_params(), self.cfg, ids,
+                           max_new_tokens=max_new_tokens,
+                           context_size=self.cfg.context_length,
+                           eos_id=self.cfg.eos_id,
+                           rng=jax.random.PRNGKey(self.global_step))
         text = token_ids_to_text(out, self.tokenizer)
         logger.info("Sample: %s", text.replace("\n", " "))
         return text
@@ -1070,3 +1084,30 @@ class Trainer:
         """Final single-file params export (reference main.py:171-172)."""
         path = os.path.join(self.output_dir, filename)
         return export_params(path, self._full_params())
+
+    def export_adapter(self, path: str) -> str:
+        """``--save_adapter``: write the trained LoRA tree as a standalone
+        npz artifact (rank/alpha + base-config fingerprint) that the
+        serving ``AdapterRegistry`` hot-loads — the multi-tenant
+        alternative to baking the adapter into ``export_final``'s merged
+        weights."""
+        from building_llm_from_scratch_tpu.models.lora import (
+            adapter_fingerprint,
+            count_lora_params,
+            save_adapter,
+        )
+
+        if not self.use_lora:
+            raise ValueError("export_adapter needs a LoRA run "
+                             "(no adapter tree to export)")
+        lora = self.state["trainable"]
+        save_adapter(path, lora, rank=self.lora_rank,
+                     alpha=self.lora_alpha, cfg=self.cfg)
+        get_metrics().event("adapter_save", step=self.global_step,
+                            path=path, rank=self.lora_rank,
+                            alpha=self.lora_alpha,
+                            n_params=count_lora_params(lora),
+                            fingerprint=adapter_fingerprint(self.cfg))
+        logger.info("Exported LoRA adapter to %s (rank %d, alpha %s).",
+                    path, self.lora_rank, self.lora_alpha)
+        return path

@@ -124,6 +124,70 @@ print(f"serving smoke ok: {len(results)} requests, "
       f"{meta['n_tick_windows']} tick windows")
 EOF
 
+echo "== multi-tenant LoRA serving smoke (train-export -> serve, CPU) =="
+JAX_PLATFORMS=cpu python - <<'EOF' || exit 1
+import json, os, tempfile
+d = tempfile.mkdtemp()
+data = os.path.join(d, "data"); os.makedirs(data)
+open(os.path.join(data, "corpus.txt"), "w").write("lora smoke corpus. " * 120)
+out = os.path.join(d, "out")
+a1 = os.path.join(d, "adapter_one.npz")
+from building_llm_from_scratch_tpu.args import get_args
+from building_llm_from_scratch_tpu.main import main
+# the REAL export path: a short LoRA training run writes artifact #1
+trainer = main(get_args([
+    "--data_dir", data, "--output_dir", out, "--debug", "--byte_tokenizer",
+    "--n_epochs", "1", "--batch_size", "4", "--eval_freq", "1000",
+    "--print_sample_iter", "100000", "--save_ckpt_freq", "100000",
+    "--warmup_steps", "1", "--use_lora", "--lora_rank", "4",
+    "--lora_alpha", "8", "--save_adapter", a1,
+]))
+assert os.path.isfile(a1), "--save_adapter wrote nothing"
+# artifact #2 from the same base config (a second tenant)
+import jax
+from building_llm_from_scratch_tpu.models.lora import (
+    init_lora_params, save_adapter)
+a2 = os.path.join(d, "adapter_two.npz")
+lora2 = init_lora_params(trainer.cfg, trainer.state["frozen"],
+                         jax.random.PRNGKey(7), rank=4)
+lora2 = jax.tree_util.tree_map(
+    lambda a: a + 0.05 * jax.random.normal(jax.random.PRNGKey(8),
+                                           a.shape, a.dtype), lora2)
+save_adapter(a2, lora2, rank=4, alpha=8.0, cfg=trainer.cfg)
+# serve 2 adapters + base traffic CONCURRENTLY on 4 slots
+reqs = os.path.join(d, "requests.jsonl")
+with open(reqs, "w") as f:
+    for i in range(9):
+        f.write(json.dumps({"prompt": "abcd"[: 1 + i % 4],
+                            "max_new_tokens": 4 + i % 3,
+                            "ignore_eos": True, "seed": i,
+                            "adapter": [None, "one", "two"][i % 3]}) + "\n")
+res = os.path.join(d, "results.jsonl")
+mj = os.path.join(d, "metrics.jsonl")
+engine = main(get_args([
+    "--mode", "serve", "--debug", "--byte_tokenizer", "--data_dir", d,
+    "--serve_prompts", reqs, "--serve_out", res,
+    "--serve_slots", "4", "--serve_max_queue", "9",
+    "--serve_adapters", f"one={a1},two={a2}",
+    "--metrics_jsonl", mj,
+]))
+results = [json.loads(l) for l in open(res)]
+assert len(results) == 9, f"expected 9 results, got {len(results)}"
+assert all(r["finish_reason"] == "length" for r in results), results
+by_adapter = sorted(r.get("adapter", "base") for r in results)
+assert by_adapter == ["base"] * 3 + ["one"] * 3 + ["two"] * 3, by_adapter
+rows = [json.loads(l) for l in open(mj)]
+loads = [r for r in rows if r.get("event") == "adapter_load"]
+assert len(loads) == 2, f"expected 2 adapter_load events: {loads}"
+recompiles = [r for r in rows if r.get("event") == "recompile"]
+assert not recompiles, f"mixed-adapter traffic recompiled: {recompiles}"
+assert engine.n_recompiles == 0
+stats = engine.stats()
+assert stats["per_adapter"]["one"]["finished"] == 3, stats
+print(f"lora serving smoke ok: 9/9 requests ({by_adapter.count('base')} "
+      f"base + 6 adapter), {len(loads)} adapter_loads, 0 recompiles")
+EOF
+
 echo "== serving drain smoke (SIGTERM + mid-run /metrics scrape, CPU) =="
 JAX_PLATFORMS=cpu python - <<'EOF' || exit 1
 import json, os, signal, socket, subprocess, sys, tempfile, time

@@ -183,6 +183,7 @@ def summarize_serving(metrics, events):
           + (f"; {len(rejected)} REJECTED over capacity" if rejected
              else "") + ")")
     summarize_serving_resilience(failed, shed, expired, events)
+    summarize_adapters(done, failed, events)
     for key, label in (("queue_wait_s", "queue wait"), ("ttft_s", "TTFT"),
                        ("tpot_s", "TPOT"), ("e2e_s", "end-to-end")):
         vals = [e[key] for e in done
@@ -211,6 +212,43 @@ def summarize_serving(metrics, events):
         print(f"  !! {summaries[-1]['n_recompiles']} RECOMPILES after "
               "warmup — prompt lengths outside the warmed bucket set "
               "(see the recompile events' leaf diffs)")
+
+
+def summarize_adapters(done, failed, events):
+    """Multi-tenant LoRA lines: per-adapter request/token/latency
+    aggregates from the ``adapter`` field of request events, plus the
+    registry's hot-load/evict history."""
+    loads = [e for e in events if e["event"] == "adapter_load"]
+    evicts = [e for e in events if e["event"] == "adapter_evict"]
+    tenants = {}
+    for e in done:
+        nm = e.get("adapter", "base")
+        t = tenants.setdefault(nm, {"done": 0, "tokens": 0, "failed": 0,
+                                    "e2e": []})
+        t["done"] += 1
+        t["tokens"] += e.get("n_tokens", 0)
+        if isinstance(e.get("e2e_s"), (int, float)):
+            t["e2e"].append(e["e2e_s"])
+    for e in failed:
+        nm = e.get("adapter", "base")
+        tenants.setdefault(nm, {"done": 0, "tokens": 0, "failed": 0,
+                                "e2e": []})["failed"] += 1
+    if not (loads or evicts or len(tenants) > 1
+            or (tenants and "base" not in tenants)):
+        return                   # single-tenant base-only run: stay quiet
+    print(f"  adapters: {len(loads)} load(s), {len(evicts)} evict(s)"
+          + ("" if not loads else " ("
+             + ", ".join(f"{e.get('name')} r{e.get('rank')}"
+                         for e in loads) + ")"))
+    for nm in sorted(tenants):
+        t = tenants[nm]
+        line = (f"    {nm:<12} {t['done']:4d} done  {t['tokens']:6d} tok")
+        if t["failed"]:
+            line += f"  {t['failed']} failed"
+        if t["e2e"]:
+            line += (f"  e2e p50 {1e3 * _pctile(t['e2e'], 50):8.2f} ms  "
+                     f"p95 {1e3 * _pctile(t['e2e'], 95):8.2f} ms")
+        print(line)
 
 
 def summarize_ticks(metrics, events):
