@@ -48,6 +48,9 @@ HOT_PATHS = {
     "building_llm_from_scratch_tpu/serving/engine.py": {
         "DecodeEngine.step",
         "DecodeEngine._admit",
+        "DecodeEngine._admit_chunked",
+        "DecodeEngine._chunk_tick",
+        "DecodeEngine._maybe_store_prefix",
         "DecodeEngine._accept_token",
         "DecodeEngine._pool_args",
         "DecodeEngine._pool_args_for",
@@ -57,6 +60,12 @@ HOT_PATHS = {
         # lock-free reference snapshots with zero device syncs
         "AdapterRegistry.pool_args",
         "AdapterRegistry.lookup",
+        "AdapterRegistry.load_tag",
+    },
+    "building_llm_from_scratch_tpu/serving/kvcache.py": {
+        # per-admission prefix probe: host-side hashing only — a device
+        # fetch here would sync the tick on every admission
+        "PrefixStore.match",
     },
     "building_llm_from_scratch_tpu/data/prefetch.py": {
         "Prefetcher._fill",

@@ -100,6 +100,11 @@ def perform_checks(args) -> None:
         if args.serve_adapter_slots < 0:
             raise ValueError("--serve_adapter_slots must be >= 0 "
                              "(0 = sized to the listed adapters).")
+        if args.serve_prefill_chunk < 0:
+            raise ValueError("--serve_prefill_chunk must be >= 0 "
+                             "(0 = monolithic bucketed prefill).")
+        if args.serve_prefix_budget_mb <= 0:
+            raise ValueError("--serve_prefix_budget_mb must be > 0.")
         if args.serve_adapters:
             from building_llm_from_scratch_tpu.serving.frontend import (
                 parse_adapter_specs,
@@ -129,6 +134,8 @@ def perform_checks(args) -> None:
             ("serve_tick_timeout", 0.0), ("serve_max_restarts", 3),
             ("serve_deadline_s", 0.0), ("serve_metrics_every", 32),
             ("serve_adapters", None), ("serve_adapter_slots", 0),
+            ("serve_prefix_cache", "off"), ("serve_prefill_chunk", 0),
+            ("serve_kv_quant", "model"), ("serve_prefix_budget_mb", 256.0),
         ) if getattr(args, name) != default]
         if stray:
             raise ValueError(
@@ -403,6 +410,40 @@ def get_args(argv=None):
                              "(admit/prefill/decode_dispatch/host_fetch/"
                              "sample_commit/callback_detok) to "
                              "--metrics_jsonl. 0 disables.")
+    parser.add_argument("--serve_prefix_cache", type=str, default="off",
+                        choices=["on", "off"],
+                        help="KV prefix caching (serving/kvcache.py): "
+                             "requests sharing a prompt prefix (system "
+                             "prompts) reuse its KV panes instead of "
+                             "recomputing the prefix forward pass; "
+                             "per-adapter namespaced, LRU-evicted under "
+                             "--serve_prefix_budget_mb. Implies chunked "
+                             "prefill (--serve_prefill_chunk, default 64 "
+                             "when unset).")
+    parser.add_argument("--serve_prefill_chunk", type=int, default=0,
+                        help="Chunked prefill: split prompt prefill into "
+                             "fixed chunks of this many tokens, "
+                             "interleaved with decode ticks — bounds the "
+                             "per-tick prefill stall a long prompt "
+                             "inflicts on co-resident requests, and "
+                             "replaces the per-bucket prefill programs "
+                             "with ONE compiled chunk program. 0 = "
+                             "monolithic bucketed prefill (historical "
+                             "behavior).")
+    parser.add_argument("--serve_kv_quant", type=str, default="model",
+                        choices=["model", "int8"],
+                        help="Slot KV-cache dtype policy: 'model' stores "
+                             "KV in the model dtype; 'int8' quantizes on "
+                             "append (per-position per-head scales, "
+                             "dequantized inside decode attention) — "
+                             "halves KV data bytes per slot, so ~2x "
+                             "--serve_slots fits the same HBM at a small "
+                             "documented accuracy tolerance.")
+    parser.add_argument("--serve_prefix_budget_mb", type=float,
+                        default=256.0,
+                        help="Prefix-store byte budget (MiB of device "
+                             "memory for cached prefix KV panes); least-"
+                             "recently-used entries evict past it.")
 
     # Training configuration
     parser.add_argument("--n_epochs", type=int, default=2,

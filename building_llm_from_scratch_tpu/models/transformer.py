@@ -607,13 +607,19 @@ def init_cache(cfg: ModelConfig, batch_size: int, max_length: int) -> Params:
     full-buffer re-layout copies the (B, T, H, D) model layout forced
     through ``causal_attention`` (the r5 profile's other 24
     copies/step).
+
+    Allocation itself lives on ``serving.kvcache.KVCachePolicy.alloc``
+    — ONE rule shared with the serving slot cache, so the two can never
+    drift (layout, per-layer split, dtype policy). The train/one-shot
+    path always uses the default policy (model dtype, no sidecars).
     """
-    shape = (batch_size, cfg.n_kv_groups, max_length, cfg.head_dim)
-    return {
-        "k": [jnp.zeros(shape, cfg.jax_dtype) for _ in range(cfg.n_layers)],
-        "v": [jnp.zeros(shape, cfg.jax_dtype) for _ in range(cfg.n_layers)],
-        "length": jnp.zeros((), jnp.int32),
-    }
+    from building_llm_from_scratch_tpu.serving.kvcache import (
+        DEFAULT_POLICY,
+    )
+
+    cache = DEFAULT_POLICY.alloc(cfg, batch_size, max_length)
+    cache["length"] = jnp.zeros((), jnp.int32)
+    return cache
 
 
 def unstack_blocks(params: Params, cfg: ModelConfig) -> list:
@@ -738,14 +744,21 @@ def forward_with_cache(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
 # one decode step.
 # ---------------------------------------------------------------------------
 
-def init_slot_cache(cfg: ModelConfig, n_slots: int, max_length: int) -> Params:
+def init_slot_cache(cfg: ModelConfig, n_slots: int, max_length: int,
+                    policy=None) -> Params:
     """Per-layer (n_slots, Hkv, Tmax, hd) k/v buffers; lengths are host
-    state (serving/engine.py), not part of the device cache."""
-    shape = (n_slots, cfg.n_kv_groups, max_length, cfg.head_dim)
-    return {
-        "k": [jnp.zeros(shape, cfg.jax_dtype) for _ in range(cfg.n_layers)],
-        "v": [jnp.zeros(shape, cfg.jax_dtype) for _ in range(cfg.n_layers)],
-    }
+    state (serving/engine.py), not part of the device cache.
+
+    ``policy`` (serving.kvcache.KVCachePolicy) owns layout and dtype:
+    the default reproduces the historical model-dtype cache; the int8
+    policy allocates int8 k/v plus fp32 per-position scale sidecars
+    (``k_scale``/``v_scale`` lists) that the slot paths below fill on
+    append and ``decode_attention`` folds back in."""
+    from building_llm_from_scratch_tpu.serving.kvcache import (
+        DEFAULT_POLICY,
+    )
+
+    return (policy or DEFAULT_POLICY).alloc(cfg, n_slots, max_length)
 
 
 def _slot_adapter_layers(adapter, cfg: ModelConfig):
@@ -767,6 +780,47 @@ def _slot_adapter_layers(adapter, cfg: ModelConfig):
     return layers, rows["head"]["weight"], s
 
 
+def _cache_quantized(cache: Params) -> bool:
+    return "k_scale" in cache
+
+
+def _slot_write(cache: Params, name: str, pane: jnp.ndarray, offsets: tuple,
+                new: Params) -> None:
+    """Append one layer's cache write into the ``new`` accumulator:
+    plain dynamic-update-slice for float caches; quantize-then-write
+    (int8 codes + the fp32 scale sidecar) for int8 caches. ``pane`` is
+    cache-native (1, Hkv, T, hd); ``offsets`` the 4-d DUS origin."""
+    buf = cache[name][len(new[name])]
+    if _cache_quantized(cache):
+        from building_llm_from_scratch_tpu.ops.decode_step import quantize_kv
+
+        codes, scale = quantize_kv(pane)
+        sbuf = cache[name + "_scale"][len(new[name + "_scale"])]
+        new[name + "_scale"].append(
+            jax.lax.dynamic_update_slice(sbuf, scale, offsets))
+        pane = codes
+    new[name].append(
+        jax.lax.dynamic_update_slice(buf, pane.astype(buf.dtype), offsets))
+
+
+def _new_cache_acc(cache: Params) -> Params:
+    return {name: [] for name in cache}
+
+
+def _layer_scales(cache: Params, l: int, slot: Optional[jnp.ndarray] = None
+                  ) -> dict:
+    """``decode_attention`` kwargs for layer ``l``'s scale sidecars
+    (empty when unquantized). ``slot`` slices one row out for the
+    single-slot chunk-prefill path."""
+    if not _cache_quantized(cache):
+        return {}
+    ks, vs = cache["k_scale"][l], cache["v_scale"][l]
+    if slot is not None:
+        ks = jax.lax.dynamic_slice(ks, (slot, 0, 0, 0), (1,) + ks.shape[1:])
+        vs = jax.lax.dynamic_slice(vs, (slot, 0, 0, 0), (1,) + vs.shape[1:])
+    return {"k_scale": ks, "v_scale": vs}
+
+
 def prefill_into_slot(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                       prompt_len: jnp.ndarray, slot: jnp.ndarray,
                       cache: Params, blocks_list: Optional[list] = None,
@@ -778,8 +832,11 @@ def prefill_into_slot(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
 
     Attention here is plain causal self-attention over the prompt itself
     (nothing earlier lives in the slot), with ``kv_length=prompt_len``
-    masking the pad keys; the pad positions' k/v land in the cache as
-    garbage and stay masked by the engine's per-slot lengths.
+    masking the pad keys. Pad-position k/v are ZEROED before the write —
+    they used to land as garbage masked only by the engine's host-side
+    lengths, which was fine while slot contents stayed request-private;
+    prefix panes (serving/kvcache.py) make them shareable state, so
+    every cache write must be a deterministic function of the prompt.
 
     ``adapter``: {"pool", "scaling", "ids" (1,)} — the request's LoRA
     adapter applied unmerged at every adapted projection (id −1 = base).
@@ -793,8 +850,10 @@ def prefill_into_slot(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     if blocks_list is None:
         blocks_list = unstack_blocks(params, cfg)
     adp_layers, head_node, head_s = _slot_adapter_layers(adapter, cfg)
-    new_k, new_v = [], []
-    for l, (p, K, V) in enumerate(zip(blocks_list, cache["k"], cache["v"])):
+    # pad-position zero mask, model layout (1, Tpb, 1, 1)
+    valid = (positions < prompt_len)[None, :, None, None]
+    new = _new_cache_acc(cache)
+    for l, p in enumerate(blocks_list):
         adp = adp_layers[l] if adp_layers is not None else None
         h = _norm(cfg, p["norm1"], x)
         q, k, v = _qkv_proj(cfg, p["attn"], h, rope, positions,
@@ -803,12 +862,12 @@ def prefill_into_slot(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                                kv_length=prompt_len)
         # (1, Tpb, Hkv, hd) -> cache-native (1, Hkv, Tpb, hd) pane at
         # (slot, 0, 0, 0); Tpb <= Tmax by the engine's admission check
-        K = jax.lax.dynamic_update_slice(
-            K, k.transpose(0, 2, 1, 3).astype(K.dtype), (slot, 0, 0, 0))
-        V = jax.lax.dynamic_update_slice(
-            V, v.transpose(0, 2, 1, 3).astype(V.dtype), (slot, 0, 0, 0))
-        new_k.append(K)
-        new_v.append(V)
+        k = jnp.where(valid, k, jnp.zeros((), k.dtype))
+        v = jnp.where(valid, v, jnp.zeros((), v.dtype))
+        _slot_write(cache, "k", k.transpose(0, 2, 1, 3), (slot, 0, 0, 0),
+                    new)
+        _slot_write(cache, "v", v.transpose(0, 2, 1, 3), (slot, 0, 0, 0),
+                    new)
         x = x + _attn_out_proj(p["attn"], out, 1, Tpb,
                                adp=adp["attn"] if adp is not None else None)
         x = x + _mlp(cfg, p["mlp"], _norm(cfg, p["norm2"], x),
@@ -817,7 +876,76 @@ def prefill_into_slot(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     last = jax.lax.dynamic_slice(x, (0, prompt_len - 1, 0),
                                  (1, 1, x.shape[-1]))
     logits = _head_logits(last, params["head"]["weight"], head_node, head_s)
-    return logits[0, 0], {"k": new_k, "v": new_v}
+    return logits[0, 0], new
+
+
+def prefill_chunk_into_slot(params: Params, cfg: ModelConfig,
+                            tokens: jnp.ndarray, chunk_start: jnp.ndarray,
+                            prompt_len: jnp.ndarray, slot: jnp.ndarray,
+                            cache: Params,
+                            blocks_list: Optional[list] = None,
+                            adapter: Optional[Params] = None
+                            ) -> Tuple[jnp.ndarray, Params]:
+    """Chunked prefill: process ``tokens`` (1, C) — the prompt span
+    [chunk_start, chunk_start + C), right-padded past ``prompt_len`` —
+    against row ``slot`` whose positions [0, chunk_start) already hold
+    valid KV (earlier chunks, or a copied prefix pane,
+    serving/kvcache.py). Returns (logits at the clamped position
+    ``prompt_len - 1 - chunk_start`` (V,), updated cache).
+
+    The chunk width C is STATIC: every prompt of every length prefills
+    through this ONE compiled program (chunk_start/prompt_len/slot are
+    data) — both the one-compiled-program invariant and the per-tick
+    prefill bound. A 2k-token prompt becomes 2k/C short calls the
+    engine interleaves with decode ticks instead of one tick-stalling
+    program.
+
+    Masking: the chunk's own k/v zero at pad positions (>= prompt_len)
+    BEFORE the cache write, and attention clamps ``kv_length`` to
+    ``prompt_len`` so the zeros are never attended either. Pad QUERY
+    rows compute garbage that stays in their own (position-wise) lanes;
+    the logits read is clamped to a valid row.
+    """
+    _, C = tokens.shape
+    rope = _rope_tables(cfg)
+    positions = chunk_start + jnp.arange(C)
+    x = _embed(cfg, params, tokens, positions, None, True)
+    if blocks_list is None:
+        blocks_list = unstack_blocks(params, cfg)
+    adp_layers, head_node, head_s = _slot_adapter_layers(adapter, cfg)
+    valid = (positions < prompt_len)[None, :, None, None]
+    kv_len = jnp.reshape(jnp.minimum(chunk_start + C, prompt_len), (1,))
+    q_pos = positions[None, :]                       # (1, C) per-row form
+    new = _new_cache_acc(cache)
+    for l, p in enumerate(blocks_list):
+        adp = adp_layers[l] if adp_layers is not None else None
+        h = _norm(cfg, p["norm1"], x)
+        q, k, v = _qkv_proj(cfg, p["attn"], h, rope, positions,
+                            adp=adp["attn"] if adp is not None else None)
+        k = jnp.where(valid, k, jnp.zeros((), k.dtype))
+        v = jnp.where(valid, v, jnp.zeros((), v.dtype))
+        _slot_write(cache, "k", k.transpose(0, 2, 1, 3),
+                    (slot, 0, chunk_start, 0), new)
+        _slot_write(cache, "v", v.transpose(0, 2, 1, 3),
+                    (slot, 0, chunk_start, 0), new)
+        # attend over THIS slot's full row, freshly including the chunk:
+        # earlier chunks / the copied prefix pane are the context
+        K_row = jax.lax.dynamic_slice(
+            new["k"][l], (slot, 0, 0, 0), (1,) + new["k"][l].shape[1:])
+        V_row = jax.lax.dynamic_slice(
+            new["v"][l], (slot, 0, 0, 0), (1,) + new["v"][l].shape[1:])
+        out = decode_attention(q, K_row, V_row, q_positions=q_pos,
+                               kv_length=kv_len,
+                               **_layer_scales(new, l, slot))
+        x = x + _attn_out_proj(p["attn"], out, 1, C,
+                               adp=adp["attn"] if adp is not None else None)
+        x = x + _mlp(cfg, p["mlp"], _norm(cfg, p["norm2"], x),
+                     adp=adp["mlp"] if adp is not None else None)
+    x = _norm(cfg, params["final_norm"], x)
+    idx = jnp.clip(prompt_len - 1 - chunk_start, 0, C - 1)
+    last = jax.lax.dynamic_slice(x, (0, idx, 0), (1, 1, x.shape[-1]))
+    logits = _head_logits(last, params["head"]["weight"], head_node, head_s)
+    return logits[0, 0], new
 
 
 def _use_bgmv(adapter, cfg: ModelConfig) -> bool:
@@ -891,7 +1019,11 @@ def decode_slots(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     )
 
     Tmax = cache["k"][0].shape[2]
+    # int8 caches keep the XLA path: decode_attention folds the scale
+    # sidecars into its einsums; the pallas kernel has no dequant pass
+    # yet (see ops/decode_step.supports_shape)
     use_fused_step = (jax.default_backend() == "tpu"
+                      and not _cache_quantized(cache)
                       and _fds_supports(1, Tmax, cfg.head_dim))
 
     if _use_bgmv(adapter, cfg):
@@ -911,7 +1043,8 @@ def decode_slots(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     else:
         adp_layers, head_node, head_s = _slot_adapter_layers(adapter, cfg)
 
-    new_k, new_v = [], []
+    quantized = _cache_quantized(cache)
+    new = _new_cache_acc(cache)
     for l, (p, K, V) in enumerate(zip(blocks_list, cache["k"], cache["v"])):
         adp = adp_layers[l] if adp_layers is not None else None
         h = _norm(cfg, p["norm1"], x)
@@ -924,17 +1057,32 @@ def decode_slots(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
 
             out, K, V = fused_decode_step(q, k.astype(K.dtype),
                                           v.astype(V.dtype), K, V, lengths)
+            new["k"].append(K)
+            new["v"].append(V)
         else:
-            K = slot_cache_append(K, k.transpose(0, 2, 1, 3), lengths)
-            V = slot_cache_append(V, v.transpose(0, 2, 1, 3), lengths)
+            kt, vt = k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3)
+            if quantized:
+                from building_llm_from_scratch_tpu.ops.decode_step import (
+                    quantize_kv,
+                )
+
+                kt, ks = quantize_kv(kt)
+                vt, vs = quantize_kv(vt)
+                new["k_scale"].append(slot_cache_append(
+                    cache["k_scale"][l], ks, lengths))
+                new["v_scale"].append(slot_cache_append(
+                    cache["v_scale"][l], vs, lengths))
+            K = slot_cache_append(K, kt, lengths)
+            V = slot_cache_append(V, vt, lengths)
+            new["k"].append(K)
+            new["v"].append(V)
             out = decode_attention(q, K, V, q_positions=positions,
-                                   kv_length=lengths + 1)
-        new_k.append(K)
-        new_v.append(V)
+                                   kv_length=lengths + 1,
+                                   **_layer_scales(new, l))
         x = x + _attn_out_proj(p["attn"], out, S, 1,
                                adp=adp["attn"] if adp is not None else None)
         x = x + _mlp(cfg, p["mlp"], _norm(cfg, p["norm2"], x),
                      adp=adp["mlp"] if adp is not None else None)
     x = _norm(cfg, params["final_norm"], x)
     logits = _head_logits(x, params["head"]["weight"], head_node, head_s)
-    return logits[:, 0], {"k": new_k, "v": new_v}
+    return logits[:, 0], new

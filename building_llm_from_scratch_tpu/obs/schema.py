@@ -35,7 +35,7 @@ from typing import Any, Dict, FrozenSet, List
 
 #: Bump when a row type or a load-bearing field changes meaning. The
 #: ``header`` row carries it; consumers key parsing decisions on it.
-SCHEMA_VERSION = 3          # v3: + "span" row type (request/tick tracing)
+SCHEMA_VERSION = 4          # v4: + prefix_* events, prefix_copy tick phase
 
 #: JSONL row discriminators (the ``type`` field).
 ROW_TYPES = ("header", "metrics", "health", "event", "span")
@@ -43,8 +43,10 @@ ROW_TYPES = ("header", "metrics", "health", "event", "span")
 #: Engine tick phases, in within-tick order (serving/engine.py accumulates
 #: wall-clock per phase and logs the sums at its metrics cadence as
 #: ``tick_<phase>_s`` fields; /metrics exports ``tick_<phase>_seconds``).
-TICK_PHASES = ("admit", "prefill", "decode_dispatch", "host_fetch",
-               "sample_commit", "callback_detok")
+#: ``prefix_copy`` is the KV memory engine's pane traffic (prefix-hit
+#: copies + post-prefill pane extraction, serving/kvcache.py).
+TICK_PHASES = ("admit", "prefix_copy", "prefill", "decode_dispatch",
+               "host_fetch", "sample_commit", "callback_detok")
 
 #: Trainer StepTimeline segments (``<segment>_s`` fields of training
 #: cadence metrics rows; obs/timeline.py owns the measurement).
@@ -195,11 +197,34 @@ _EVENT_LIST: List[EventSpec] = [
           optional=("row", "n_loaded"),
           doc="registry unloaded an adapter (row reused only once no "
               "active slot references it)"),
+    # -- serving: KV-cache memory engine ----------------------------------
+    _spec("prefix_hit", required=("request_id",),
+          optional=("span_tokens", "prompt_tokens", "key",
+                    "n_suffix_chunks", "adapter", "late"),
+          doc="a stored prefix matched: its panes were copied into the "
+              "slot (zero forward FLOPs for the cached span). late=True "
+              "is the mid-prefill catch-up hit — a co-admitted sharer "
+              "jumping ahead on a pane stored after its admission"),
+    _spec("prefix_miss", required=("request_id",),
+          optional=("prompt_tokens", "adapter"),
+          doc="no stored prefix matched; the prompt prefills in full "
+              "(and its chunk-aligned prefix is stored for successors)"),
+    _spec("prefix_evict", required=("key",),
+          optional=("bytes", "span_tokens", "hits", "age_s",
+                    "entries_left", "bytes_left"),
+          doc="LRU eviction under the prefix store's byte budget "
+              "(pinned entries are never evicted)"),
+    _spec("prefix_insert", required=("request_id",),
+          optional=("span_tokens", "bytes", "entries", "adapter"),
+          doc="a completed prefill's chunk-aligned prefix pane entered "
+              "the store"),
     # -- serving: engine lifecycle ----------------------------------------
     _spec("serve_warmup",
           optional=("n_prefill_buckets", "buckets", "seconds", "n_slots",
-                    "max_len"),
-          doc="prefill buckets + decode program compiled; watchers frozen"),
+                    "max_len", "kv_quant", "prefix_cache", "prefill_chunk",
+                    "kv_bytes_per_slot", "prefix_pane_tokens"),
+          doc="prefill programs + decode program compiled; watchers "
+              "frozen; records the KVCachePolicy (quant/chunk/prefix)"),
     _spec("serve_summary", open_fields=True,
           doc="shutdown stats snapshot (histogram percentiles, counters)"),
     _spec("serve_error", required=("error",),

@@ -254,6 +254,8 @@ def decode_attention(
     *,
     q_positions: jnp.ndarray,     # (Tq,) or (B, Tq) absolute positions
     kv_length: jnp.ndarray,       # scalar or (B,): valid cache prefix
+    k_scale: Optional[jnp.ndarray] = None,   # (B, Hkv, Tmax, 1) int8 cache
+    v_scale: Optional[jnp.ndarray] = None,   # (B, Hkv, Tmax, 1) scales
 ) -> jnp.ndarray:
     """Attention for KV-cache decode, consuming the cache in its OWN
     (B, H, T, D) layout.
@@ -269,15 +271,29 @@ def decode_attention(
     Per-row ``q_positions`` (B, Tq) + ``kv_length`` (B,) serve the serving
     engine's slot batch, where every row is a different request at a
     different sequence length (serving/engine.py).
+
+    ``k_scale``/``v_scale`` dequantize an int8 cache (serving/kvcache.py
+    int8 policy) WITHOUT materializing a dequantized copy: the per-
+    position scales are constant over head_dim, so they factor out of
+    the score dot (``q . (k8*s) = (q . k8) * s``) and fold into the
+    probability row before the value dot (``sum_k p_k*(v8_k*s_k) =
+    sum_k (p_k*s_k)*v8_k``) — exactly equal to dequantize-then-attend.
     """
     B, Tq, Hq, D = q.shape
     _, Hkv, Tkv, _ = k_cache.shape
     G = Hq // Hkv
     scale = 1.0 / jnp.sqrt(jnp.asarray(D, dtype=jnp.float32))
+    if k_scale is not None:
+        k_cache = k_cache.astype(jnp.float32)
+        v_cache = v_cache.astype(jnp.float32)
     # (B, Hkv, G, Tq, D) — tiny transpose (Tq is 1 for decode steps)
     qg = q.reshape(B, Tq, Hkv, G, D).transpose(0, 2, 3, 1, 4)
     scores = jnp.einsum("bhgqd,bhkd->bhgqk", qg, k_cache,
                         preferred_element_type=jnp.float32) * scale
+    if k_scale is not None:
+        # (B, Hkv, Tkv, 1) -> (B, Hkv, 1, 1, Tkv), broadcast over (G,
+        # Tq): one multiply per score, the whole K-side dequant cost
+        scores = scores * k_scale[:, :, :, 0][:, :, None, None, :]
     kv_pos = jnp.arange(Tkv)
     if q_positions.ndim == 2:
         # per-row positions/lengths: mask (B, Tq, Tkv) -> (B, 1, 1, Tq, Tkv)
@@ -291,6 +307,10 @@ def decode_attention(
     scores = jnp.where(mask, scores,
                        jnp.asarray(_NEG_INF, scores.dtype))
     weights = jax.nn.softmax(scores, axis=-1).astype(v_cache.dtype)
+    if v_scale is not None:
+        # fold the V-side scales into the probability row (exact):
+        # sum_k p_k * (v8_k * s_k) == sum_k (p_k * s_k) * v8_k
+        weights = weights * v_scale[:, :, :, 0][:, :, None, None, :]
     out = jnp.einsum("bhgqk,bhkd->bhgqd", weights, v_cache)
     # (B, Hkv, G, Tq, D) -> (B, Tq, Hq, D)
     return out.transpose(0, 3, 1, 2, 4).reshape(B, Tq, Hq, D)

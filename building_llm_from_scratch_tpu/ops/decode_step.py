@@ -74,6 +74,34 @@ def _kernel(len_ref, q_ref, kn_ref, vn_ref, K_ref, V_ref,
         preferred_element_type=jnp.float32).astype(o_ref.dtype)
 
 
+#: symmetric int8 KV quantization floor: an all-zero position (zeroed
+#: pad, never-written cache row) quantizes to scale EPS and exact-zero
+#: codes, so dequantization is exactly zero — byte-deterministic panes
+KV_QUANT_EPS = 1e-8
+
+
+def quantize_kv(x: jnp.ndarray) -> tuple:
+    """Symmetric int8 quantization over the trailing head_dim axis:
+    one fp32 scale per (..., position, head) written — computed at
+    APPEND time, so every cache write is self-describing and appends at
+    different times never re-scale each other's history.
+
+    Returns (codes int8 (..., hd), scales fp32 (..., 1)) with
+    ``codes * scales ~= x`` (max error scale/2 per element)."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    scale = jnp.maximum(amax / 127.0, KV_QUANT_EPS)
+    codes = jnp.clip(jnp.round(xf / scale), -127.0, 127.0).astype(jnp.int8)
+    return codes, scale
+
+
+def dequantize_kv(codes: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
+    """Inverse of ``quantize_kv`` (fp32). The decode path never calls
+    this on a whole cache — ``decode_attention`` folds the scales into
+    its einsums instead — but parity tests and one-off consumers do."""
+    return codes.astype(jnp.float32) * scale
+
+
 def slot_cache_append(cache: jnp.ndarray, new: jnp.ndarray,
                       lengths: jnp.ndarray) -> jnp.ndarray:
     """Batched slot-indexed cache append: write ``new`` (B, Hkv, Tq, hd)
@@ -234,6 +262,10 @@ def supports_shape(Tq: int, Tmax: int, hd: int) -> bool:
     merge_store window [t8, t8+8) must stay inside the pane for every
     t < Tmax). Prefill (Tq > 1) keeps the dynamic-update-slice +
     ``decode_attention`` path — it runs once per generation, so its
-    copies don't matter."""
+    copies don't matter. int8-quantized caches (serving/kvcache.py) are
+    additionally gated OFF by the caller: the kernel would need an
+    in-VMEM dequant pass (quantize on merge_store, fold scales into the
+    score/value dots) that has no hardware to be A/B'd against in this
+    container — the XLA path carries the scales instead."""
     return (Tq == 1 and hd % 64 == 0 and hd <= 256 and Tmax <= 8192
             and Tmax % 8 == 0)

@@ -26,6 +26,10 @@ from building_llm_from_scratch_tpu.serving.adapters import (
     AdapterRegistryFullError,
 )
 from building_llm_from_scratch_tpu.serving.engine import DecodeEngine
+from building_llm_from_scratch_tpu.serving.kvcache import (
+    KVCachePolicy,
+    PrefixStore,
+)
 from building_llm_from_scratch_tpu.serving.queue import (
     EngineDrainingError,
     QueueFullError,
@@ -51,6 +55,8 @@ __all__ = [
     "EngineDrainingError",
     "EngineSupervisor",
     "FaultHooks",
+    "KVCachePolicy",
+    "PrefixStore",
     "QueueFullError",
     "Request",
     "RequestExpiredError",

@@ -136,6 +136,11 @@ class AdapterRegistry:
         self._meta: Dict[str, dict] = {}    # guarded-by: _lock [writes]
         self._rows: List[Optional[str]] = (
             [None] * self.capacity)         # guarded-by: _lock
+        # name -> "name#<install-seq>": the per-INSTALL identity consumers
+        # key derived state on (the prefix store namespaces cached KV by
+        # it, so evict-and-reload with different weights can never serve
+        # a stale pane). Copy-on-write like _by_name for lock-free reads.
+        self._tags: Dict[str, str] = {}     # guarded-by: _lock [writes]
         self._in_use_probe: Optional[Callable[[], Set[int]]] = None
         self.n_loads = 0                    # guarded-by: _lock
         self.n_evicts = 0                   # guarded-by: _lock
@@ -183,6 +188,15 @@ class AdapterRegistry:
                 f"adapter '{name}' is not loaded (loaded: "
                 f"{sorted(self._by_name) or 'none'})")
         return row
+
+    def load_tag(self, name: str) -> Optional[str]:
+        """Per-install identity for ``name`` (``name#<seq>``), or None
+        when not loaded. Lock-free snapshot read (called per admission
+        by the engine's prefix-store namespacing): a reloaded adapter
+        gets a fresh tag, so state derived from the OLD install — cached
+        prefix KV panes above all — silently stops matching instead of
+        serving stale weights' output."""
+        return self._tags.get(name)
 
     def names(self) -> List[str]:
         return sorted(self._by_name)
@@ -289,6 +303,7 @@ class AdapterRegistry:
             self._by_name = {**self._by_name, name: row}
             self._meta = {**self._meta, name: meta}
             self.n_loads += 1
+            self._tags = {**self._tags, name: f"{name}#{self.n_loads}"}
             n_loaded = self.n_loaded
         get_metrics().event(
             "adapter_load", name=name, path=path, row=row, rank=rank,
@@ -315,6 +330,9 @@ class AdapterRegistry:
             meta = dict(self._meta)
             meta.pop(name, None)
             self._meta = meta
+            tags = dict(self._tags)
+            tags.pop(name, None)
+            self._tags = tags
             self.n_evicts += 1
             n_loaded = self.n_loaded
         get_metrics().event("adapter_evict", name=name, row=row,

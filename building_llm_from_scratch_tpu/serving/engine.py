@@ -63,6 +63,7 @@ from building_llm_from_scratch_tpu.generate import (
 from building_llm_from_scratch_tpu.models.transformer import (
     decode_slots,
     init_slot_cache,
+    prefill_chunk_into_slot,
     prefill_into_slot,
     unstack_blocks,
 )
@@ -75,6 +76,12 @@ from building_llm_from_scratch_tpu.obs.metrics import (
 )
 from building_llm_from_scratch_tpu.obs.schema import TICK_PHASES
 from building_llm_from_scratch_tpu.serving.adapters import BASE_ADAPTER
+from building_llm_from_scratch_tpu.serving.kvcache import (
+    KVCachePolicy,
+    PrefixStore,
+    copy_prefix_into_slot,
+    extract_prefix_panes,
+)
 from building_llm_from_scratch_tpu.serving.queue import (
     EngineDrainingError,
     QueueFullError,
@@ -128,12 +135,18 @@ class DecodeEngine:
                  tick_timeout_s: float = 0.0, max_restarts: int = 3,
                  restart_backoff_s: float = 0.5,
                  hooks: Optional[FaultHooks] = None,
-                 adapters=None):
+                 adapters=None,
+                 kv_policy: Optional[KVCachePolicy] = None):
         import jax
 
         self.cfg = cfg
         self.params = params
         self.tokenizer = tokenizer
+        #: serving/kvcache.KVCachePolicy — KV layout/dtype + prefix
+        #: policy. STATIC per engine: it decides which prefill tier
+        #: compiles (monolithic-bucketed vs ONE chunk program) and the
+        #: cache pytree's dtypes; hits/misses/spans are per-call data.
+        self.kv_policy = kv_policy or KVCachePolicy()
         #: serving/adapters.AdapterRegistry (or None = base model only).
         #: The stacked pool + per-slot adapter ids become per-call data
         #: arguments of the compiled programs — multi-tenant traffic
@@ -158,8 +171,31 @@ class DecodeEngine:
         self.queue = RequestQueue(max_queue)
         self.scheduler = Scheduler(self.n_slots)
         self.cache = init_slot_cache(
-            cfg, self.n_slots, self.max_len)            # guarded-by: _lock
+            cfg, self.n_slots, self.max_len,
+            policy=self.kv_policy)                      # guarded-by: _lock
         self._blocks = unstack_blocks(params, cfg)
+        #: chunked-prefill progress per slot (slot -> host dict); a slot
+        #: present here is ADMITTED but not yet decoding — the decode
+        #: tick computes (and ignores) its row, and its next-write
+        #: position doubles as the row's length so the decode step's
+        #: garbage append lands exactly where the next chunk overwrites
+        self._prefill_state: dict = {}                  # guarded-by: _lock
+        #: static pane width for prefix panes (copy/extract programs):
+        #: one width -> ONE copy + ONE extract program, hit spans are
+        #: data against it
+        self._prefix_pane_len = self._bucket_len(
+            max(self.warmup_prompt_cap, 1))
+        self.prefix_store: Optional[PrefixStore] = None
+        if self.kv_policy.prefix_cache:
+            from building_llm_from_scratch_tpu.models.lora import (
+                adapter_fingerprint,
+            )
+
+            self.prefix_store = PrefixStore(
+                adapter_fingerprint(cfg),
+                chunk_tokens=self.kv_policy.prefill_chunk,
+                budget_bytes=self.kv_policy.prefix_budget_bytes,
+                pane_tokens=self._prefix_pane_len)
 
         S = self.n_slots
         # host-owned per-slot state; the device owns only the big k/v.
@@ -184,19 +220,38 @@ class DecodeEngine:
             # slot still decodes against (hot-evict-then-load safety)
             self.adapters.set_in_use_probe(self._adapter_rows_in_use)
 
-        # donate the cache panes: the caller always rebinds self.cache to
-        # the outputs, so XLA may alias input->output and the pallas
-        # in-place append really is in place (no per-tick full-cache copy)
-        prefill_jit = jax.jit(self._prefill_impl, donate_argnums=(0, 1))
-        decode_jit = jax.jit(self._decode_impl, donate_argnums=(0, 1))
+        # donate the cache pytree: the caller always rebinds self.cache
+        # to the outputs, so XLA may alias input->output and the pallas
+        # in-place append really is in place (no per-tick full-cache
+        # copy). The prefix-EXTRACT program deliberately does NOT donate
+        # — it only reads the cache (the next donating call reuses the
+        # same arrays).
+        import functools
+
+        prefill_jit = jax.jit(self._prefill_impl, donate_argnums=(0,))
+        chunk_jit = jax.jit(self._chunk_impl, donate_argnums=(0,))
+        copy_jit = jax.jit(self._copy_impl, donate_argnums=(0,))
+        extract_jit = jax.jit(functools.partial(
+            extract_prefix_panes, pane_len=self._prefix_pane_len))
+        decode_jit = jax.jit(self._decode_impl, donate_argnums=(0,))
         if watch_compiles:
             self._prefill = CompileWatcher(prefill_jit,
                                            label="serve_prefill",
                                            multi_program=True)
+            self._prefill_chunk = CompileWatcher(
+                chunk_jit, label="serve_prefill_chunk", multi_program=True)
+            self._prefix_copy = CompileWatcher(
+                copy_jit, label="serve_prefix_copy", multi_program=True)
+            self._prefix_extract = CompileWatcher(
+                extract_jit, label="serve_prefix_extract",
+                multi_program=True)
             self._decode = CompileWatcher(decode_jit, label="serve_decode",
                                           multi_program=True)
         else:
             self._prefill = prefill_jit
+            self._prefill_chunk = chunk_jit
+            self._prefix_copy = copy_jit
+            self._prefix_extract = extract_jit
             self._decode = decode_jit
 
         self._lock = threading.RLock()
@@ -239,6 +294,15 @@ class DecodeEngine:
         self.tpot_hist = Histogram()
         self.queue_wait_hist = Histogram()
         self.e2e_hist = Histogram()
+        #: per-tick prefill+prefix-copy wall (ticks that did prefill
+        #: work): the chunked-prefill scoreboard — its p95 is the
+        #: head-of-line bound chunking exists to shrink. Finer buckets
+        #: than the request-latency default: chunked-vs-monolithic A/Bs
+        #: differ by small factors the 2.5x latency ladder can't resolve
+        self.tick_prefill_hist = Histogram(bounds=(
+            0.0002, 0.0005, 0.001, 0.002, 0.004, 0.008, 0.015, 0.03,
+            0.06, 0.12, 0.2, 0.3, 0.45, 0.7, 1.0, 1.5, 2.2, 3.3, 5.0,
+            7.5, 11.0, 17.0, 26.0, 40.0, 60.0))
         self.slo_window = RollingRatio(window_s=300.0)
         self._t_start_mono = time.monotonic()
         self._window_tokens = 0             # guarded-by: _lock
@@ -256,11 +320,17 @@ class DecodeEngine:
         self.tick_seconds_total = 0.0                    # guarded-by: _lock
         self._window_ticks = 0                           # guarded-by: _lock
         self._win_t0_wall = time.time()                  # guarded-by: _lock
+        # KV-engine window counters (chunked prefill + prefix cache):
+        # drained into the cadence metrics row like the tick phases
+        self._window_prefill_chunks = 0                  # guarded-by: _lock
+        self._window_prefix_hits = 0                     # guarded-by: _lock
+        self._window_prefix_misses = 0                   # guarded-by: _lock
+        self._tick_pf0 = 0.0                             # guarded-by: _lock
 
     # -- jitted programs (close over params/cfg/blocks so per-tick call
     # signatures carry only the small mutable state + caches) -------------
 
-    def _prefill_impl(self, cache_k, cache_v, tokens, prompt_len, slot,
+    def _prefill_impl(self, cache, tokens, prompt_len, slot,
                       base_key, temp, topk, pool=None, pool_scale=None,
                       adapter_id=None):
         import jax.numpy as jnp
@@ -271,7 +341,7 @@ class DecodeEngine:
                        "ids": jnp.reshape(adapter_id, (1,))}
         logits, cache = prefill_into_slot(
             self.params, self.cfg, tokens, prompt_len, slot,
-            {"k": cache_k, "v": cache_v}, self._blocks, adapter=adapter)
+            cache, self._blocks, adapter=adapter)
         key0 = token_rng(base_key, 0)
         tok = sample_tokens_dynamic(
             logits[None], key0[None], jnp.reshape(temp, (1,)),
@@ -280,9 +350,37 @@ class DecodeEngine:
         # stream garbage — the host retires the request with an error
         # status instead (scalar flag; adds one all-reduce over V)
         ok = jnp.all(jnp.isfinite(logits))
-        return tok, ok, cache["k"], cache["v"]
+        return tok, ok, cache
 
-    def _decode_impl(self, cache_k, cache_v, tokens, lengths, base_keys,
+    def _chunk_impl(self, cache, tokens, chunk_start, prompt_len, slot,
+                    base_key, temp, topk, pool=None, pool_scale=None,
+                    adapter_id=None):
+        """One C-token prefill chunk (the chunked tier's ONE compiled
+        prefill program). Samples the would-be first token every call —
+        the host only reads it (and the finite flag) on the FINAL chunk,
+        so non-final chunks cost zero device->host syncs."""
+        import jax.numpy as jnp
+
+        adapter = None
+        if pool is not None:
+            adapter = {"pool": pool, "scaling": pool_scale,
+                       "ids": jnp.reshape(adapter_id, (1,))}
+        logits, cache = prefill_chunk_into_slot(
+            self.params, self.cfg, tokens, chunk_start, prompt_len, slot,
+            cache, self._blocks, adapter=adapter)
+        key0 = token_rng(base_key, 0)
+        tok = sample_tokens_dynamic(
+            logits[None], key0[None], jnp.reshape(temp, (1,)),
+            jnp.reshape(topk, (1,)), self.max_top_k)[0]
+        ok = jnp.all(jnp.isfinite(logits))
+        return tok, ok, cache
+
+    def _copy_impl(self, cache, panes, slot):
+        """Prefix HIT: one batched DUS per layer writes the stored panes
+        into row ``slot`` — the whole cached-span compute (no forward)."""
+        return copy_prefix_into_slot(cache, panes, slot)
+
+    def _decode_impl(self, cache, tokens, lengths, base_keys,
                      n_gen, temps, topks, pool=None, pool_scale=None,
                      adapter_ids=None):
         import jax
@@ -294,7 +392,7 @@ class DecodeEngine:
                        "ids": adapter_ids}
         logits, cache = decode_slots(
             self.params, self.cfg, tokens[:, None], lengths,
-            {"k": cache_k, "v": cache_v}, self._blocks, adapter=adapter)
+            cache, self._blocks, adapter=adapter)
         keys = jax.vmap(token_rng)(base_keys, n_gen)
         nxt = sample_tokens_dynamic(logits, keys, temps, topks,
                                     self.max_top_k)
@@ -302,7 +400,7 @@ class DecodeEngine:
         # poisoned row (bad KV state) goes non-finite ALONE — the host
         # retires just that slot (reason non_finite_logits)
         ok = jnp.all(jnp.isfinite(logits), axis=-1)
-        return nxt, ok, cache["k"], cache["v"]
+        return nxt, ok, cache
 
     def _pool_args(self) -> tuple:
         """Positional tail for the compiled programs: the registry's
@@ -636,9 +734,6 @@ class DecodeEngine:
         import jax
 
         Tp = int(req.prompt_ids.size)   # graft-ok: GL011 host numpy size
-        Tpb = self._bucket_len(Tp)
-        padded = np.zeros((1, Tpb), np.int32)
-        padded[0, :Tp] = req.prompt_ids
         # explicit device_get: the ONLY sanctioned d->h idiom in the tick
         # path — the transfer-guard sentry (analysis/runtime.py) lets it
         # through while failing any implicit fetch that sneaks in
@@ -668,18 +763,27 @@ class DecodeEngine:
             self._fail_request(slot, req, f"prefill failed: {e!r}",
                                reason="prefill_error")
             return
+        if self.kv_policy.prefill_chunk > 0:
+            self._admit_chunked(slot, req, gen, base_key, temp, topk,
+                                adapter_row)
+            return
+        # monolithic tier only: bucket-pad the whole prompt (the chunked
+        # tier builds its C-token chunk arrays per tick instead)
+        Tpb = self._bucket_len(Tp)
+        padded = np.zeros((1, Tpb), np.int32)
+        padded[0, :Tp] = req.prompt_ids
         # the `prefill` phase spans dispatch THROUGH the ok-scalar sync:
         # the jitted call returns before the device finishes (async
         # dispatch), so timing the call alone would book the execution
         # wait into whatever host line happens to touch a result first
         t_pf = time.perf_counter()
-        tok, ok, k, v = self._prefill(self.cache["k"], self.cache["v"],
-                                      padded, np.int32(Tp), np.int32(slot),
-                                      base_key, temp, topk,
-                                      *self._pool_args_for(adapter_row))
+        tok, ok, cache = self._prefill(self.cache, padded, np.int32(Tp),
+                                       np.int32(slot), base_key, temp,
+                                       topk,
+                                       *self._pool_args_for(adapter_row))
         if self._generation != gen:
             return          # abandoned mid-prefill: commit nothing
-        self.cache = {"k": k, "v": v}
+        self.cache = cache
         req.state = RUNNING
         req.slot = slot
         req.t_admit = time.monotonic()
@@ -701,22 +805,226 @@ class DecodeEngine:
             return
         self._accept_token(slot, req, int(jax.device_get(tok)), gen)
 
+    # -- chunked prefill + prefix cache ------------------------------------
+
+    def _adapter_tag(self, req: Request) -> Optional[str]:
+        """Prefix-store namespace for one request: the registry's LOAD
+        tag (name + per-install sequence), so an adapter evicted and
+        reloaded — possibly with different weights — can never hit the
+        old install's panes. Base traffic shares one namespace. None —
+        the adapter vanished between admission's row resolution and
+        here (hot evict race) — means NO namespace: the request must
+        neither hit another tenant's panes nor store its own under one,
+        so the caller skips the prefix store entirely."""
+        if req.params.adapter is None or self.adapters is None:
+            return BASE_ADAPTER
+        return self.adapters.load_tag(req.params.adapter)
+
+    # holds: _lock
+    def _admit_chunked(self, slot: int, req: Request, gen: int,
+                       base_key, temp, topk, adapter_row) -> None:
+        """Chunked admission: probe the prefix store, copy a hit's panes
+        into the slot (one batched DUS program — zero forward FLOPs for
+        the cached span), and queue the suffix for the per-tick chunk
+        pump (``_chunk_tick``). The first sampled token arrives when the
+        final chunk lands, so slot state is primed here but the request
+        only joins the decode batch then."""
+        Tp = int(req.prompt_ids.size)   # graft-ok: GL011 host numpy size
+        pos = 0
+        tag = (self._adapter_tag(req) if self.prefix_store is not None
+               else None)
+        if tag is not None:
+            span, entry = self.prefix_store.match(req.prompt_ids, tag)
+            if entry is not None:
+                if not self._apply_prefix_hit(slot, req, gen, span, entry,
+                                              late=False):
+                    return      # abandoned mid-copy: commit nothing
+                pos = span
+            else:
+                self._window_prefix_misses += 1
+                get_metrics().event("prefix_miss", request_id=req.id,
+                                    prompt_tokens=Tp,
+                                    adapter=req.params.adapter)
+        req.state = RUNNING
+        req.slot = slot
+        req.t_admit = time.monotonic()
+        # slot state primed now; `_lengths` tracks the NEXT write
+        # position while prefilling, so the decode step's garbage append
+        # for this row lands exactly where the next chunk overwrites
+        self._lengths[slot] = pos
+        self._n_gen[slot] = 0
+        self._base_keys[slot] = base_key
+        self._temps[slot] = temp
+        self._topks[slot] = topk
+        self._adapter_ids[slot] = adapter_row
+        self._prefill_state[slot] = {
+            "req": req, "pos": pos, "Tp": Tp, "base_key": base_key,
+            "temp": temp, "topk": topk, "adapter_row": adapter_row,
+            "stored": False,
+        }
+
+    # holds: _lock
+    def _apply_prefix_hit(self, slot: int, req: Request, gen: int,
+                          span: int, entry, late: bool) -> bool:
+        """Copy a matched (pinned) entry's panes into ``slot`` and emit
+        the hit. Returns False on a generation abort (nothing committed).
+        ``late``: the catch-up hit — a mid-prefill slot jumping ahead on
+        a pane a co-resident sharer just stored (see ``_chunk_tick``)."""
+        t_cp = time.perf_counter()
+        try:
+            cache = self._prefix_copy(self.cache, entry.panes,
+                                      np.int32(slot))
+        finally:
+            self.prefix_store.release(entry)
+        if self._generation != gen:
+            return False
+        self.cache = cache
+        self._window_prefix_hits += 1
+        self._tick_add("prefix_copy", time.perf_counter() - t_cp)
+        Tp = int(req.prompt_ids.size)   # graft-ok: GL011 host numpy size
+        get_metrics().event(
+            "prefix_hit", request_id=req.id, span_tokens=span,
+            prompt_tokens=Tp, key=entry.key, late=late,
+            n_suffix_chunks=-(-(Tp - span)
+                              // self.kv_policy.prefill_chunk),
+            adapter=req.params.adapter)
+        return True
+
+    # holds: _lock
+    def _chunk_tick(self, gen: int) -> bool:
+        """One prefill chunk for every mid-prefill slot — the per-tick
+        prefill work is bounded by n_prefilling x one C-token program,
+        whatever the prompt lengths. Returns False on a generation
+        abort (the caller books tick wall and bails)."""
+        import jax
+
+        C = self.kv_policy.prefill_chunk
+        for slot in sorted(self._prefill_state):
+            st = self._prefill_state[slot]
+            req: Request = st["req"]
+            Tp = st["Tp"]
+            span_cap = (self.prefix_store.storable_span(Tp)
+                        if self.prefix_store is not None else 0)
+            # catch-up probe: a slot co-admitted with the FIRST sharer of
+            # a prefix missed at admission (the store was empty), but the
+            # sharer's pane may have landed since (early insertion below)
+            # — jump ahead by pane copy instead of recomputing chunks.
+            # count_miss=False: only admission misses are workload misses
+            tag = (self._adapter_tag(req)
+                   if self.prefix_store is not None and st["pos"] < span_cap
+                   else None)
+            if tag is not None:
+                span, entry = self.prefix_store.match(
+                    req.prompt_ids, tag,
+                    min_span=st["pos"], count_miss=False)
+                if entry is not None:
+                    if not self._apply_prefix_hit(slot, req, gen, span,
+                                                  entry, late=True):
+                        return False
+                    st["pos"] = span
+                    self._lengths[slot] = span
+            t_pf = time.perf_counter()
+            lo = st["pos"]
+            hi = min(lo + C, Tp)
+            chunk = np.zeros((1, C), np.int32)
+            chunk[0, : hi - lo] = req.prompt_ids[lo:hi]
+            tok, ok, cache = self._prefill_chunk(
+                self.cache, chunk, np.int32(lo), np.int32(Tp),
+                np.int32(slot), st["base_key"], st["temp"], st["topk"],
+                *self._pool_args_for(st["adapter_row"]))
+            if self._generation != gen:
+                return False        # abandoned mid-chunk: commit nothing
+            self.cache = cache
+            st["pos"] = lo + C
+            self._window_prefill_chunks += 1
+            self._tick_add("prefill", time.perf_counter() - t_pf)
+            # EARLY insertion: the moment the chunk covering the storable
+            # span lands, the pane [0, span) is final — store it NOW so
+            # co-admitted sharers (still mid-prefill behind us) catch up
+            # this very tick instead of after our whole prompt
+            if (self.prefix_store is not None and not st["stored"]
+                    and 0 < span_cap <= st["pos"]):
+                st["stored"] = True
+                self._maybe_store_prefix(slot, req, gen)
+                if self._generation != gen:
+                    return False
+            if st["pos"] < Tp:
+                self._lengths[slot] = st["pos"]
+                continue
+            # final chunk: the request's first token. Explicit fetch —
+            # the ONLY chunk that syncs (mirrors the legacy prefill)
+            t_pf = time.perf_counter()
+            ok_host = bool(jax.device_get(ok))
+            self._tick_add("prefill", time.perf_counter() - t_pf)
+            del self._prefill_state[slot]
+            self._lengths[slot] = Tp
+            if self.hooks.poison_nan(req):
+                self._poison_slot_cache(slot)  # fault injection (tests)
+            if not ok_host:
+                self._fail_request(slot, req,
+                                   "non-finite logits in prefill",
+                                   reason="non_finite_logits")
+                continue
+            self._accept_token(slot, req, int(jax.device_get(tok)), gen)
+            if self._generation != gen:
+                return False
+        return True
+
+    # holds: _lock
+    def _maybe_store_prefix(self, slot: int, req: Request,
+                            gen: int) -> None:
+        """After a completed prefill, extract the slot's chunk-aligned
+        prefix pane and insert it into the store (miss path only — a
+        present key is just touched). Runs BEFORE the first decode
+        append, so the pane is a pure function of (prefix tokens,
+        params, adapter); the extract program additionally zero-clamps
+        everything past the span (byte-determinism — see
+        ``kvcache.extract_prefix_panes``)."""
+        if self.prefix_store is None:
+            return
+        Tp = int(req.prompt_ids.size)   # graft-ok: GL011 host numpy size
+        span = self.prefix_store.storable_span(Tp)
+        if span <= 0:
+            return
+        tag = self._adapter_tag(req)
+        if tag is None:
+            return      # adapter evicted mid-flight: no namespace to own
+        prefix_ids = req.prompt_ids[:span]
+        if self.prefix_store.contains(prefix_ids, tag):
+            return
+        t_ex = time.perf_counter()
+        panes = self._prefix_extract(self.cache, np.int32(slot),
+                                     np.int32(span))
+        self._tick_add("prefix_copy", time.perf_counter() - t_ex)
+        if self._generation != gen:
+            return
+        nbytes = self.prefix_store.insert(prefix_ids, tag, panes)
+        if nbytes:
+            get_metrics().event(
+                "prefix_insert", request_id=req.id, span_tokens=span,
+                bytes=nbytes, entries=self.prefix_store.n_entries,
+                adapter=req.params.adapter)
+
     # holds: _lock
     def _poison_slot_cache(self, slot: int) -> None:
         """Overwrite one slot's KV rows with NaN (fault-injection hook):
         the next decode tick's logits for that row go non-finite IN-GRAPH,
         exercising the finite guard through the real compiled program —
         same shapes, zero recompiles, co-resident rows untouched (their
-        attention never reads another slot's rows)."""
+        attention never reads another slot's rows). int8 caches poison
+        through the FLOAT leaves (the scale sidecars): int8 codes can't
+        hold NaN, but a NaN scale makes every dequantized value NaN."""
         import jax.numpy as jnp
 
         def nan_row(layer):
+            if not jnp.issubdtype(layer.dtype, jnp.floating):
+                return layer
             host = np.asarray(layer).copy()
             host[slot] = np.nan
             return jnp.asarray(host)
 
-        self.cache = {"k": [nan_row(K) for K in self.cache["k"]],
-                      "v": [nan_row(V) for V in self.cache["v"]]}
+        self.cache = {name: [nan_row(buf) for buf in bufs]
+                      for name, bufs in self.cache.items()}
 
     # -- tracing / tick accounting ----------------------------------------
 
@@ -741,10 +1049,16 @@ class DecodeEngine:
         totals. Called on EVERY exit from the timed part of ``step()`` —
         including generation-abort returns, which have already booked
         phase seconds: skipping the total there would let a restart
-        window's phases sum past its ``tick_total_s``."""
+        window's phases sum past its ``tick_total_s``. Also folds the
+        tick's prefill+prefix-copy wall into ``tick_prefill_hist`` (the
+        per-tick distribution the chunking A/B reads)."""
         dt = time.perf_counter() - t0
         self._tick_acc_total += dt
         self.tick_seconds_total += dt
+        pf = (self.tick_phase_totals["prefill"]
+              + self.tick_phase_totals["prefix_copy"]) - self._tick_pf0
+        if pf > 0:
+            self.tick_prefill_hist.observe(pf)
 
     # -- the tick ---------------------------------------------------------
 
@@ -765,15 +1079,19 @@ class DecodeEngine:
             if self._generation != gen or self._dead is not None:
                 return False
             t_tick0 = time.perf_counter()
+            self._tick_pf0 = (self.tick_phase_totals["prefill"]
+                              + self.tick_phase_totals["prefix_copy"])
             self.hooks.before_tick(self)       # injected hang/fault point
             if self._generation != gen:
                 self._book_tick_wall(t_tick0)
                 return False
             # tick-phase accounting: `admit` is the admission/cancel/
-            # bookkeeping remainder — the nested prefill device calls and
-            # client callbacks accumulate into their own phases, so they
-            # are subtracted out via before/after snapshots
+            # bookkeeping remainder — the nested prefill/prefix-copy
+            # device calls and client callbacks accumulate into their own
+            # phases, so they are subtracted out via before/after
+            # snapshots
             nested0 = (self._tick_acc["prefill"]
+                       + self._tick_acc["prefix_copy"]
                        + self._tick_acc["callback_detok"])
             t_adm0 = time.perf_counter()
             # re-run admission until no progress: a request can finish
@@ -793,25 +1111,50 @@ class DecodeEngine:
                     break
             # client cancellations retire at the tick boundary: the slot
             # frees NOW instead of decoding to max_new_tokens for nobody
+            # (mid-prefill slots included: _free_slot drops their state)
             for slot, req in self.scheduler.active():
                 if req._cancelled:
                     self._fail_request(slot, req, "cancelled by client",
                                        reason="cancelled",
                                        finish=FINISH_CANCELLED)
-            active = self.scheduler.active()
             nested = (self._tick_acc["prefill"]
+                      + self._tick_acc["prefix_copy"]
                       + self._tick_acc["callback_detok"]) - nested0
             self._tick_add("admit", max(
                 time.perf_counter() - t_adm0 - nested, 0.0))
+            # chunked-prefill pump: one C-token chunk per mid-prefill
+            # slot, BEFORE the decode step — a slot whose final chunk
+            # lands here joins this very tick's decode batch (the same
+            # admit-then-decode cadence the monolithic path has)
+            if self._prefill_state:
+                if not self._chunk_tick(gen):
+                    self._book_tick_wall(t_tick0)
+                    return False
+            active = self.scheduler.active()
             if not active:
-                # all slots free => admission drained the queue too (an
-                # admission-only tick — eos/budget hit during prefill —
-                # still books its wall time so phases keep summing to it)
+                # all slots free. Legacy: admission drained the queue
+                # too. Chunked: a first-token eos inside _chunk_tick can
+                # free the last slot with requests still queued — report
+                # progress so the next tick admits them (an admission-
+                # only tick still books its wall time so phases keep
+                # summing to it)
                 self._book_tick_wall(t_tick0)
-                return False
+                return len(self.queue) > 0
+            # mid-prefill slots ride through the fixed-shape decode step
+            # as ignored rows (their garbage append lands at the next
+            # chunk's write position — see _admit_chunked); with NO row
+            # actually decoding, skip the step entirely
+            decoding = [(s, r) for s, r in active
+                        if s not in self._prefill_state]
+            if not decoding:
+                self.n_ticks += 1
+                self._window_ticks += 1
+                self._book_tick_wall(t_tick0)
+                self._maybe_log_metrics()
+                return True
             t_dec = time.perf_counter()
-            nxt, ok, k, v = self._decode(
-                self.cache["k"], self.cache["v"], self._last_tokens,
+            nxt, ok, cache = self._decode(
+                self.cache, self._last_tokens,
                 self._lengths, self._base_keys, self._n_gen, self._temps,
                 self._topks, *(self._pool_args() + (self._adapter_ids,)
                                if self.adapters is not None else ()))
@@ -827,13 +1170,13 @@ class DecodeEngine:
             # the tick's only two sanctioned d->h transfers, and the
             # transfer-guard sentry test proves nothing implicit remains
             t_fetch = time.perf_counter()
-            self.cache = {"k": k, "v": v}
+            self.cache = cache
             nxt = jax.device_get(nxt)
             ok_rows = jax.device_get(ok)
             self._tick_add("host_fetch", time.perf_counter() - t_fetch)
             cb0 = self._tick_acc["callback_detok"]
             t_commit = time.perf_counter()
-            for slot, req in active:
+            for slot, req in decoding:
                 # a slow-client hook inside _accept_token is a wedge point
                 # the supervisor may abandon mid-loop — stop committing
                 # rows the moment the generation moves on
@@ -936,6 +1279,7 @@ class DecodeEngine:
     # holds: _lock
     def _free_slot(self, slot: int) -> None:
         self.scheduler.retire(slot)
+        self._prefill_state.pop(slot, None)    # mid-prefill retirement
         self._lengths[slot] = 0
         self._last_tokens[slot] = 0
         self._n_gen[slot] = 0
@@ -1037,6 +1381,12 @@ class DecodeEngine:
         # had (next-token + ok mask; guard-tested)
         phases = {f"tick_{ph}_s": round(self._tick_acc[ph], 6)
                   for ph in TICK_PHASES}
+        kv = {}
+        if self.kv_policy.prefill_chunk > 0:
+            kv["prefill_chunks"] = self._window_prefill_chunks
+        if self.prefix_store is not None:
+            kv["prefix_hits"] = self._window_prefix_hits
+            kv["prefix_misses"] = self._window_prefix_misses
         sink.log_metrics(self.n_ticks,
                          serve_tok_s=round(self._window_tokens / dt, 2),
                          requests_finished=self.requests_finished,
@@ -1045,53 +1395,75 @@ class DecodeEngine:
                          win_t0=round(self._win_t0_wall, 6),
                          win_dur_s=round(now_wall - self._win_t0_wall, 6),
                          tick_total_s=round(self._tick_acc_total, 6),
-                         **phases)
+                         **phases, **kv)
         self._window_tokens = 0
         self._window_t0 = now
         self._window_ticks = 0
         self._win_t0_wall = now_wall
+        self._window_prefill_chunks = 0
+        self._window_prefix_hits = 0
+        self._window_prefix_misses = 0
         self._tick_acc = {ph: 0.0 for ph in TICK_PHASES}
         self._tick_acc_total = 0.0
 
     # -- warmup / compile discipline --------------------------------------
 
     def warmup(self) -> None:
-        """Compile the legitimate program set up front — one prefill per
-        prompt bucket + THE decode step — then freeze the watchers so any
-        later signature is reported as a bucket-miss ``recompile``. The
-        warmup traffic runs through slot 0 with throwaway state; host
-        state is reset after. Runs under the engine lock: warmup normally
-        precedes ``start()``, but holding the lock makes a late warmup
-        (or a concurrent early submit) safe instead of silently corrupting
-        slot state."""
+        """Compile the legitimate program set up front, then freeze the
+        watchers so any later signature is reported as a bucket-miss
+        ``recompile``. Monolithic tier: one prefill per prompt bucket.
+        Chunked tier (``kv_policy.prefill_chunk > 0``): ONE chunk
+        program (+ the prefix copy/extract pair when the store is on) —
+        chunk offset, prompt length, span and slot are all data, so the
+        whole prefill tier warms in a constant number of compiles.
+        Plus THE decode step either way. The warmup traffic runs through
+        slot 0 with throwaway state; host state is reset after. Runs
+        under the engine lock: warmup normally precedes ``start()``, but
+        holding the lock makes a late warmup (or a concurrent early
+        submit) safe instead of silently corrupting slot state."""
         import jax
 
         t0 = time.monotonic()
         with self._lock:
-            buckets = self.prompt_buckets()
             zero_key = np.zeros_like(self._base_keys[0])
             # warm WITH the adapter-pool argument tail when a registry is
             # attached (id −1 = base): the adapter graph is part of THE
             # one decode program, so later adapter traffic — and every
             # hot-load, which swaps same-shaped pool arrays — hits the
             # frozen signature exactly
-            for Tpb in buckets:
-                dummy = np.zeros((1, Tpb), np.int32)
-                tok, _ok, k, v = self._prefill(
-                    self.cache["k"], self.cache["v"], dummy, np.int32(1),
+            if self.kv_policy.prefill_chunk > 0:
+                buckets = [self.kv_policy.prefill_chunk]
+                dummy = np.zeros((1, self.kv_policy.prefill_chunk),
+                                 np.int32)
+                tok, _ok, cache = self._prefill_chunk(
+                    self.cache, dummy, np.int32(0), np.int32(1),
                     np.int32(0), zero_key, np.float32(0.0), np.int32(0),
                     *self._pool_args_for(np.int32(-1)))
-                self.cache = {"k": k, "v": v}
-            nxt, _ok, k, v = self._decode(
-                self.cache["k"], self.cache["v"], self._last_tokens,
+                self.cache = cache
+                if self.prefix_store is not None:
+                    panes = self._prefix_extract(self.cache, np.int32(0),
+                                                 np.int32(1))
+                    self.cache = self._prefix_copy(self.cache, panes,
+                                                   np.int32(0))
+            else:
+                buckets = self.prompt_buckets()
+                for Tpb in buckets:
+                    dummy = np.zeros((1, Tpb), np.int32)
+                    tok, _ok, cache = self._prefill(
+                        self.cache, dummy, np.int32(1),
+                        np.int32(0), zero_key, np.float32(0.0),
+                        np.int32(0), *self._pool_args_for(np.int32(-1)))
+                    self.cache = cache
+            nxt, _ok, cache = self._decode(
+                self.cache, self._last_tokens,
                 self._lengths, self._base_keys, self._n_gen, self._temps,
                 self._topks, *(self._pool_args() + (self._adapter_ids,)
                                if self.adapters is not None else ()))
-            self.cache = {"k": k, "v": v}
+            self.cache = cache
             jax.device_get(nxt)               # block until compiled + ran
             if isinstance(self._prefill, CompileWatcher):
-                self._prefill.freeze()
-                self._decode.freeze()
+                for w in self._watchers():
+                    w.freeze()
             self._lengths[:] = 0
             self._last_tokens[:] = 0
             self._n_gen[:] = 0
@@ -1102,19 +1474,35 @@ class DecodeEngine:
             self._win_t0_wall = time.time()
             self._window_tokens = 0
             self.warmed_up = True
+        bps = self.kv_policy.bytes_per_slot(self.cfg, self.max_len)
         get_metrics().event(
             "serve_warmup", n_prefill_buckets=len(buckets),
             buckets=buckets, seconds=round(time.monotonic() - t0, 3),
-            n_slots=self.n_slots, max_len=self.max_len)
-        logger.info("Serving warmup: %d prefill buckets %s + 1 decode "
-                    "program in %.2fs", len(buckets), buckets,
-                    time.monotonic() - t0)
+            n_slots=self.n_slots, max_len=self.max_len,
+            kv_bytes_per_slot=bps["total_bytes"],
+            prefix_pane_tokens=(self._prefix_pane_len
+                                if self.prefix_store is not None
+                                else None),
+            **self.kv_policy.describe())
+        logger.info(
+            "Serving warmup: %s + 1 decode program in %.2fs (kv %s, "
+            "%.2f MiB/slot%s)",
+            (f"1 chunk program (C={self.kv_policy.prefill_chunk})"
+             if self.kv_policy.prefill_chunk > 0
+             else f"{len(buckets)} prefill buckets {buckets}"),
+            time.monotonic() - t0, self.kv_policy.kv_quant,
+            bps["total_bytes"] / 1024 ** 2,
+            ", prefix cache on" if self.prefix_store is not None else "")
+
+    def _watchers(self) -> list:
+        return [w for w in (self._prefill, self._prefill_chunk,
+                            self._prefix_copy, self._prefix_extract,
+                            self._decode)
+                if isinstance(w, CompileWatcher)]
 
     @property
     def n_recompiles(self) -> int:
-        if isinstance(self._decode, CompileWatcher):
-            return self._decode.n_recompiles + self._prefill.n_recompiles
-        return 0
+        return sum(w.n_recompiles for w in self._watchers())
 
     # -- background loop ---------------------------------------------------
 
@@ -1208,11 +1596,15 @@ class DecodeEngine:
                 self._temps[:] = 0.0
                 self._topks[:] = 0
                 self._adapter_ids[:] = -1
+                self._prefill_state.clear()
                 # the old cache may be donation-poisoned or numerically
                 # corrupt; a fresh one has identical shapes/dtypes, so the
-                # frozen compiled programs accept it without recompiling
+                # frozen compiled programs accept it without recompiling.
+                # The prefix store survives: its panes are independent
+                # device arrays a wedged tick can't have corrupted.
                 self.cache = init_slot_cache(self.cfg, self.n_slots,
-                                             self.max_len)
+                                             self.max_len,
+                                             policy=self.kv_policy)
             backoff = self.restart_backoff_s * (2.0 ** (n_restart - 1))
             get_metrics().event(
                 "engine_restart", reason=reason, detail=detail,
@@ -1454,6 +1846,9 @@ class DecodeEngine:
                     for nm, c in sorted(self._adapter_counts.items())}
             if self.adapters is not None:
                 out["adapters_loaded"] = self.adapters.n_loaded
+            out["kv_policy"] = self.kv_policy.describe()
+            if self.prefix_store is not None:
+                out["prefix_store"] = self.prefix_store.stats()
             slo = self.slo_window.ratio()
             if slo is not None:
                 out["slo_miss_ratio"] = round(slo, 6)
@@ -1497,6 +1892,12 @@ class DecodeEngine:
             for ph in TICK_PHASES:
                 counters[f"tick_{ph}_seconds"] = round(
                     self.tick_phase_totals[ph], 6)
+            if self.prefix_store is not None:
+                counters["prefix_hits"] = self.prefix_store.n_hits
+                counters["prefix_misses"] = self.prefix_store.n_misses
+                counters["prefix_evictions"] = \
+                    self.prefix_store.n_evictions
+                counters["prefix_inserts"] = self.prefix_store.n_inserts
             # per-adapter labeled series (multi-tenant accounting): one
             # requests/tokens counter triple per adapter name seen, plus
             # a live per-adapter slot-occupancy gauge
@@ -1524,6 +1925,17 @@ class DecodeEngine:
             if self.adapters is not None:
                 gauges["adapters_loaded"] = self.adapters.n_loaded
                 gauges["adapter_capacity"] = self.adapters.capacity
+            # KV memory-engine gauges: bytes/slot is the HBM number that
+            # sizes n_slots (the int8 policy's whole point); the
+            # hit-ratio is the prefix cache's scoreboard
+            gauges["kv_bytes_per_slot"] = self.kv_policy.bytes_per_slot(
+                self.cfg, self.max_len)["total_bytes"]
+            if self.prefix_store is not None:
+                ratio = self.prefix_store.hit_ratio()
+                gauges["prefix_hit_ratio"] = (round(ratio, 6)
+                                              if ratio is not None else 0.0)
+                gauges["prefix_entries"] = self.prefix_store.n_entries
+                gauges["prefix_bytes"] = self.prefix_store.bytes_total
             # always exported: a scrape gap (series absent until the
             # first deadline-carrying request) reads as "no data" on a
             # dashboard when the truth is "no misses"
@@ -1535,6 +1947,7 @@ class DecodeEngine:
                 "tpot_seconds": self.tpot_hist,
                 "queue_wait_seconds": self.queue_wait_hist,
                 "e2e_seconds": self.e2e_hist,
+                "tick_prefill_seconds": self.tick_prefill_hist,
             }
         finally:
             if locked:

@@ -376,6 +376,21 @@ def run_serve(args, comps, metric_logger) -> DecodeEngine:
                     "capacity %d.", adapters.n_loaded,
                     ", ".join(adapters.names()), adapters.capacity)
 
+    from building_llm_from_scratch_tpu.serving.kvcache import KVCachePolicy
+
+    prefix_on = getattr(args, "serve_prefix_cache", "off") == "on"
+    chunk = getattr(args, "serve_prefill_chunk", 0)
+    if prefix_on and chunk <= 0:
+        chunk = 64          # prefix caching implies chunked prefill
+        logger.info("--serve_prefix_cache on: defaulting "
+                    "--serve_prefill_chunk to 64.")
+    kv_policy = KVCachePolicy(
+        kv_quant=getattr(args, "serve_kv_quant", "model"),
+        prefix_cache=prefix_on,
+        prefill_chunk=chunk,
+        prefix_budget_bytes=int(
+            getattr(args, "serve_prefix_budget_mb", 256.0) * 1024 ** 2),
+    )
     engine = DecodeEngine(
         comps.cfg, comps.params, comps.tokenizer,
         n_slots=args.serve_slots,
@@ -388,6 +403,7 @@ def run_serve(args, comps, metric_logger) -> DecodeEngine:
         max_restarts=args.serve_max_restarts,
         metrics_every=args.serve_metrics_every,
         adapters=adapters,
+        kv_policy=kv_policy,
     )
     stall = None
     if args.stall_timeout > 0 and engine.supervisor is None:

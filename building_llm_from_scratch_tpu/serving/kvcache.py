@@ -1,0 +1,434 @@
+"""KV-cache memory engine: layout/dtype policy, shared-prefix store,
+and the chunked-prefill pane primitives.
+
+Before this module the serving KV tier hardcoded three assumptions that
+each cost real capacity or latency at scale:
+
+  1. every request prefills its FULL prompt from scratch — a fleet where
+     millions of users share a handful of system prompts recomputes the
+     same prefix forward pass per request;
+  2. prompt prefill is monolithic — a 2k-token prompt holds the engine
+     lock for one giant program call, stalling the decode tick for every
+     co-resident request (PR 7's per-tick phase timeline measures exactly
+     this head-of-line blocking);
+  3. the slot cache is the model dtype, contiguous — KV bytes, not
+     compute, cap ``n_slots`` well below what HBM allows.
+
+One ``KVCachePolicy`` object (layout + dtype + prefix policy) replaces
+all three:
+
+  - **prefix caching** (``prefix_cache=True``): a hash-keyed
+    (token-ids, model-fingerprint, adapter-tag) store of per-layer KV
+    panes. A shared prefix prefills ONCE; later requests copy its panes
+    into their slot with one batched dynamic-update-slice and
+    chunk-prefill only the suffix — zero forward FLOPs for the cached
+    span. Per-adapter namespacing (the registry's load tag) keeps each
+    tenant's cached prefix adapter-consistent with unmerged-LoRA
+    prefill, and a reloaded adapter gets a fresh tag so stale panes can
+    never hit. LRU eviction under a byte budget with in-use pinning
+    (the same non-reuse discipline as ``AdapterRegistry``).
+  - **chunked prefill** (``prefill_chunk=C``): prompts prefill in
+    fixed-size C-token chunks interleaved with decode ticks. The chunk
+    shape is STATIC, so the whole prefill tier is ONE compiled program
+    (vs one per prompt-length bucket) and ``tick_prefill_s`` is bounded
+    by one chunk's wall time instead of the longest prompt's.
+  - **int8 slot KV** (``kv_quant="int8"``): symmetric per-(slot, layer,
+    head, position) scale quantization on append, dequantized inside
+    ``decode_attention`` (scales fold into the score/value einsums, no
+    dequantized cache copy ever materializes). KV data bytes halve
+    exactly vs bf16; the fp32 scale sidecar adds 2/head_dim overhead
+    (6.25% at head_dim 64), so total cache bytes are ~0.53x.
+
+Compile discipline: pane width and chunk size are static; hit/miss/
+evict, span length and slot index are DATA. The engine's frozen
+``CompileWatcher`` set (prefill-or-chunk, copy, extract, decode) is
+warmed up front, so live traffic — including store eviction and adapter
+churn — runs with zero recompiles (test-pinned).
+
+Layering: this module sees only configs + obs (events) + jax; the model
+side (``models/transformer.py``) imports ``KVCachePolicy.alloc`` lazily
+so train-time ``init_cache`` and serving ``init_slot_cache`` share one
+allocation rule without an import cycle.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from building_llm_from_scratch_tpu.configs import ModelConfig
+from building_llm_from_scratch_tpu.obs.metrics import get_metrics
+from building_llm_from_scratch_tpu.utils.logging import setup_logger
+
+logger = setup_logger(__name__)
+
+Params = Dict[str, Any]
+
+KV_QUANT_CHOICES = ("model", "int8")
+
+
+@dataclass(frozen=True)
+class KVCachePolicy:
+    """Layout + dtype + prefix policy for the slot KV cache.
+
+    The policy is STATIC per engine: it decides the cache pytree's
+    structure (scale sidecars or not), leaf dtypes, and which prefill
+    tier (monolithic-bucketed vs chunked) the engine compiles. Request
+    traffic — hits, misses, spans, slots — is data against those fixed
+    shapes.
+    """
+
+    kv_quant: str = "model"          # "model" (cfg dtype) | "int8"
+    prefix_cache: bool = False
+    prefill_chunk: int = 0           # 0 = monolithic bucketed prefill
+    prefix_budget_bytes: int = 256 * 1024 ** 2
+
+    def __post_init__(self):
+        if self.kv_quant not in KV_QUANT_CHOICES:
+            raise ValueError(
+                f"kv_quant must be one of {KV_QUANT_CHOICES}, "
+                f"got '{self.kv_quant}'")
+        if self.prefill_chunk < 0:
+            raise ValueError("prefill_chunk must be >= 0")
+        if self.prefix_cache and self.prefill_chunk <= 0:
+            raise ValueError(
+                "prefix_cache needs chunked prefill (prefill_chunk > 0): "
+                "the suffix after a cached span prefills in chunks — the "
+                "monolithic bucketed prefill always starts at position 0")
+        if self.prefix_budget_bytes < 0:
+            raise ValueError("prefix_budget_bytes must be >= 0")
+
+    # -- layout ------------------------------------------------------------
+
+    @property
+    def quantized(self) -> bool:
+        return self.kv_quant == "int8"
+
+    def cache_dtype(self, cfg: ModelConfig):
+        import jax.numpy as jnp
+
+        return jnp.int8 if self.quantized else cfg.jax_dtype
+
+    def alloc(self, cfg: ModelConfig, n_rows: int, max_length: int) -> Params:
+        """Allocate the per-layer KV buffers: the ONE allocation rule
+        behind train-time ``init_cache`` and serving ``init_slot_cache``
+        (previously three identical ``jnp.zeros`` blocks that could
+        silently drift).
+
+        Layout (n_rows, Hkv, max_length, head_dim) — attention-native
+        (see ``init_cache``'s docstring for the per-layer-buffer and
+        layout rationale). Quantized policies add fp32 scale sidecars
+        (n_rows, Hkv, max_length, 1): one symmetric scale per written
+        position per head.
+        """
+        import jax.numpy as jnp
+
+        shape = (n_rows, cfg.n_kv_groups, max_length, cfg.head_dim)
+        dt = self.cache_dtype(cfg)
+        cache: Params = {
+            "k": [jnp.zeros(shape, dt) for _ in range(cfg.n_layers)],
+            "v": [jnp.zeros(shape, dt) for _ in range(cfg.n_layers)],
+        }
+        if self.quantized:
+            sshape = (n_rows, cfg.n_kv_groups, max_length, 1)
+            cache["k_scale"] = [jnp.zeros(sshape, jnp.float32)
+                                for _ in range(cfg.n_layers)]
+            cache["v_scale"] = [jnp.zeros(sshape, jnp.float32)
+                                for _ in range(cfg.n_layers)]
+        return cache
+
+    def bytes_per_slot(self, cfg: ModelConfig, max_length: int) -> Dict[str, int]:
+        """Per-slot cache bytes under this policy: the HBM number that
+        decides ``n_slots`` (proven against ``memory_analysis()`` /
+        ``nbytes`` in tests). ``kv_bytes`` is the K+V data alone — int8
+        halves it exactly vs bf16; ``scale_bytes`` is the quantization
+        sidecar (0 unquantized)."""
+        import jax.numpy as jnp
+
+        per_pos = cfg.n_kv_groups * cfg.head_dim
+        width = jnp.dtype(self.cache_dtype(cfg)).itemsize
+        kv = 2 * cfg.n_layers * max_length * per_pos * width
+        scale = (2 * cfg.n_layers * max_length * cfg.n_kv_groups * 4
+                 if self.quantized else 0)
+        return {"kv_bytes": kv, "scale_bytes": scale,
+                "total_bytes": kv + scale,
+                "bytes_per_token": (kv + scale) // max_length}
+
+    def describe(self) -> Dict[str, Any]:
+        """Event-payload summary (rides ``serve_warmup``)."""
+        return {"kv_quant": self.kv_quant,
+                "prefix_cache": self.prefix_cache,
+                "prefill_chunk": self.prefill_chunk}
+
+
+#: slot caches allocated before the policy object existed (or by older
+#: call sites passing policy=None) behave exactly like this
+DEFAULT_POLICY = KVCachePolicy()
+
+
+def cache_is_quantized(cache: Params) -> bool:
+    """Data-driven quantization probe: the cache pytree itself says
+    whether appends must quantize and attention must dequantize — the
+    model code never needs the policy object."""
+    return "k_scale" in cache
+
+
+def cache_nbytes(cache: Params) -> int:
+    """Total device bytes of one cache pytree — per-layer buffer LISTS
+    (slot caches) or stacked pane ARRAYS (prefix panes) alike."""
+    total = 0
+    for leaves in cache.values():
+        if isinstance(leaves, (list, tuple)):
+            total += sum(leaf.nbytes for leaf in leaves)
+        else:
+            total += leaves.nbytes
+    return total
+
+
+# ---------------------------------------------------------------------------
+# pane primitives (jitted by the engine; pane width is STATIC)
+# ---------------------------------------------------------------------------
+
+def copy_prefix_into_slot(cache: Params, panes: Params, slot) -> Params:
+    """Write a stored prefix's stacked per-layer panes into row ``slot``
+    of the slot cache: one dynamic-update-slice per layer per k/v (and
+    per scale sidecar when quantized). ``panes`` leaves are
+    (L, Hkv, P, hd) / (L, Hkv, P, 1) with P static; ``slot`` is data.
+
+    This is the whole prefix-HIT compute: no embedding, no projection,
+    no attention — zero prompt-forward FLOPs for the cached span
+    (test-asserted via a forward-call spy)."""
+    import jax
+
+    def write(bufs, pane):
+        return [jax.lax.dynamic_update_slice(
+                    buf, pane[layer][None].astype(buf.dtype),
+                    (slot, 0, 0, 0))
+                for layer, buf in enumerate(bufs)]
+
+    return {name: write(bufs, panes[name]) for name, bufs in cache.items()}
+
+
+def extract_prefix_panes(cache: Params, slot, n_valid, *,
+                         pane_len: int) -> Params:
+    """Read row ``slot``'s first ``pane_len`` positions out of the slot
+    cache as stacked (L, Hkv, pane_len, ...) panes, ZEROING every
+    position >= ``n_valid``.
+
+    The zeroing is load-bearing, not cosmetic: positions past the
+    prefix span hold whatever the slot saw last (the request's own
+    suffix KV, a previous occupant's decode tail, pad garbage) — all of
+    it request-private state that must never become shareable. Clamping
+    to the span makes a stored pane a pure function of
+    (prefix tokens, params, adapter): byte-deterministic, so its hash
+    key and any audit of store contents are stable across donors."""
+    import jax
+    import jax.numpy as jnp
+
+    keep = jnp.arange(pane_len) < n_valid
+
+    def take(bufs):
+        rows = []
+        for buf in bufs:
+            row = jax.lax.dynamic_slice(
+                buf, (slot, 0, 0, 0), (1,) + buf.shape[1:])[0]
+            row = row[:, :pane_len]
+            rows.append(jnp.where(keep[None, :, None], row,
+                                  jnp.zeros((), buf.dtype)))
+        return jnp.stack(rows)
+
+    return {name: take(bufs) for name, bufs in cache.items()}
+
+
+# ---------------------------------------------------------------------------
+# the prefix store
+# ---------------------------------------------------------------------------
+
+class _Entry:
+    __slots__ = ("key", "panes", "span", "nbytes", "pins", "hits",
+                 "t_insert")
+
+    def __init__(self, key: str, panes: Params, span: int, nbytes: int):
+        self.key = key
+        self.panes = panes
+        self.span = span
+        self.nbytes = nbytes
+        self.pins = 0
+        self.hits = 0
+        self.t_insert = time.monotonic()
+
+
+class PrefixStore:
+    """Hash-keyed LRU store of device-resident prefix KV panes.
+
+    Keys are sha1(model-fingerprint, adapter-tag, token-ids): a pane can
+    only ever hit for the exact tokens, base weights, and adapter load
+    it was computed under. Spans are CHUNK-GRANULAR — lookups probe the
+    longest multiple-of-``chunk_tokens`` prefix first and walk down, so
+    a prompt sharing only part of a stored prefix still reuses the
+    shared chunks.
+
+    Concurrency: the engine calls ``match``/``insert``/``release`` under
+    its own lock, but mutations also serialize on the store lock so
+    registry-style admin (stats, external eviction) is safe from any
+    thread. Pinning follows the ``AdapterRegistry`` non-reuse
+    discipline: an entry pinned by an in-flight copy is never evicted —
+    eviction skips it and charges the budget overrun to the next insert.
+    """
+
+    def __init__(self, fingerprint: str, *, chunk_tokens: int,
+                 budget_bytes: int, pane_tokens: int):
+        if chunk_tokens < 1:
+            raise ValueError("chunk_tokens must be >= 1")
+        self.fingerprint = fingerprint
+        self.chunk_tokens = int(chunk_tokens)
+        self.budget_bytes = int(budget_bytes)
+        self.pane_tokens = int(pane_tokens)
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[str, _Entry]" = OrderedDict()
+        self.bytes_total = 0            # guarded-by: _lock
+        self.n_hits = 0                 # guarded-by: _lock
+        self.n_misses = 0               # guarded-by: _lock
+        self.n_inserts = 0              # guarded-by: _lock
+        self.n_evictions = 0            # guarded-by: _lock
+        self.n_insert_skips = 0         # guarded-by: _lock
+
+    # -- keys --------------------------------------------------------------
+
+    def key(self, token_ids, tag: str) -> str:
+        h = hashlib.sha1()
+        h.update(self.fingerprint.encode())
+        h.update(b"\x00")
+        h.update(tag.encode())
+        h.update(b"\x00")
+        h.update(np.ascontiguousarray(token_ids, np.int32).tobytes())
+        return h.hexdigest()
+
+    def storable_span(self, prompt_len: int) -> int:
+        """Longest chunk-aligned span of a ``prompt_len`` prompt worth
+        storing: capped one below the prompt (a hit must leave >= 1
+        suffix token to produce first-token logits) and at the static
+        pane width."""
+        span = ((prompt_len - 1) // self.chunk_tokens) * self.chunk_tokens
+        return min(span, self.pane_tokens)
+
+    # -- engine-side hot path ----------------------------------------------
+
+    def match(self, prompt_ids, tag: str, *, min_span: int = 0,
+              count_miss: bool = True) -> Tuple[int, Optional[_Entry]]:
+        """Longest-prefix lookup for one prompt. Returns (span, entry):
+        span 0 / None on a miss. A returned entry is PINNED — the caller
+        must ``release`` it after copying its panes.
+
+        ``min_span``: only spans strictly longer count (the mid-prefill
+        catch-up probe — a pane no longer than what the slot already
+        holds is not a hit). ``count_miss=False`` keeps that repeated
+        probe from inflating the miss ratio: only admission-time misses
+        are real workload misses."""
+        n_max = self.storable_span(len(prompt_ids))
+        for m in range(n_max // self.chunk_tokens, 0, -1):
+            span = m * self.chunk_tokens
+            if span <= min_span:
+                break
+            k = self.key(prompt_ids[:span], tag)
+            with self._lock:
+                entry = self._entries.get(k)
+                if entry is not None:
+                    self._entries.move_to_end(k)
+                    entry.hits += 1
+                    entry.pins += 1
+                    self.n_hits += 1
+                    return span, entry
+        if count_miss:
+            with self._lock:
+                self.n_misses += 1
+        return 0, None
+
+    def release(self, entry: _Entry) -> None:
+        with self._lock:
+            entry.pins = max(entry.pins - 1, 0)
+
+    def contains(self, token_ids, tag: str) -> bool:
+        k = self.key(token_ids, tag)
+        with self._lock:
+            if k in self._entries:
+                self._entries.move_to_end(k)
+                return True
+        return False
+
+    def insert(self, token_ids, tag: str, panes: Params) -> int:
+        """Store one prefix's panes under the LRU byte budget; evicts
+        least-recently-used UNPINNED entries to make room. Returns the
+        entry's byte size, or 0 — skipped (and counted) — when the
+        entry alone exceeds the budget or everything evictable is
+        pinned (also 0, uncounted, when the key is already stored)."""
+        nbytes = cache_nbytes(panes)
+        k = self.key(token_ids, tag)
+        evicted = []
+        with self._lock:
+            if k in self._entries:
+                self._entries.move_to_end(k)
+                return 0
+            if nbytes > self.budget_bytes:
+                self.n_insert_skips += 1
+                return 0
+            while self.bytes_total + nbytes > self.budget_bytes:
+                victim_key = next(
+                    (key for key, e in self._entries.items() if e.pins == 0),
+                    None)
+                if victim_key is None:       # everything evictable pinned
+                    self.n_insert_skips += 1
+                    return 0
+                victim = self._entries.pop(victim_key)
+                self.bytes_total -= victim.nbytes
+                self.n_evictions += 1
+                evicted.append(victim)
+            entry = _Entry(k, panes, len(token_ids), nbytes)
+            self._entries[k] = entry
+            self.bytes_total += nbytes
+            self.n_inserts += 1
+            n_entries = len(self._entries)
+            bytes_total = self.bytes_total
+        for victim in evicted:
+            get_metrics().event(
+                "prefix_evict", key=victim.key, bytes=victim.nbytes,
+                span_tokens=victim.span, hits=victim.hits,
+                age_s=round(time.monotonic() - victim.t_insert, 3),
+                entries_left=n_entries, bytes_left=bytes_total)
+        logger.debug("Prefix stored: %s span %d (%d bytes, %d entries, "
+                     "%d evicted).", k[:12], len(token_ids), nbytes,
+                     n_entries, len(evicted))
+        return nbytes
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def n_entries(self) -> int:
+        return len(self._entries)
+
+    def hit_ratio(self) -> Optional[float]:
+        with self._lock:
+            hits, misses = self.n_hits, self.n_misses
+        n = hits + misses
+        return (hits / n) if n else None
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "bytes": self.bytes_total,
+                "budget_bytes": self.budget_bytes,
+                "hits": self.n_hits,
+                "misses": self.n_misses,
+                "inserts": self.n_inserts,
+                "evictions": self.n_evictions,
+                "insert_skips": self.n_insert_skips,
+                "chunk_tokens": self.chunk_tokens,
+                "pane_tokens": self.pane_tokens,
+            }

@@ -188,6 +188,55 @@ print(f"lora serving smoke ok: 9/9 requests ({by_adapter.count('base')} "
       f"base + 6 adapter), {len(loads)} adapter_loads, 0 recompiles")
 EOF
 
+echo "== KV memory engine smoke (prefix cache + chunked prefill + int8, CPU) =="
+JAX_PLATFORMS=cpu python - <<'EOF' || exit 1
+import json, os, tempfile
+d = tempfile.mkdtemp()
+# 8 requests sharing ONE system prompt, served through the CLI with the
+# full KV memory engine on: prefix cache + chunked prefill + int8 slot
+# KV. The --debug model's context is 16 tokens, so the chunk is 8 (the
+# 64-token variant is exercised in tests/test_kvcache.py with a larger
+# test model): the shared 8-byte prefix is chunk-aligned, so request 1
+# prefills + stores it and requests 2..8 must all HIT.
+reqs = os.path.join(d, "requests.jsonl")
+system = "abcdefgh"                       # 8 shared prefix tokens (bytes)
+with open(reqs, "w") as f:
+    for i in range(8):
+        f.write(json.dumps({"prompt": system + "ij"[i % 2],
+                            "max_new_tokens": 4,
+                            "ignore_eos": True, "seed": i}) + "\n")
+out = os.path.join(d, "results.jsonl")
+mj = os.path.join(d, "metrics.jsonl")
+from building_llm_from_scratch_tpu.args import get_args
+from building_llm_from_scratch_tpu.main import main
+engine = main(get_args([
+    "--mode", "serve", "--debug", "--byte_tokenizer", "--data_dir", d,
+    "--serve_prompts", reqs, "--serve_out", out,
+    "--serve_slots", "4", "--serve_max_queue", "8",
+    "--serve_prefix_cache", "on", "--serve_prefill_chunk", "8",
+    "--serve_kv_quant", "int8",
+    "--metrics_jsonl", mj,
+]))
+results = [json.loads(l) for l in open(out)]
+assert len(results) == 8, f"expected 8 results, got {len(results)}"
+assert all(r["finish_reason"] == "length" for r in results), results
+rows = [json.loads(l) for l in open(mj)]
+hits = [r for r in rows if r.get("event") == "prefix_hit"]
+misses = [r for r in rows if r.get("event") == "prefix_miss"]
+assert len(hits) >= 7, f"expected >=7 prefix hits, got {len(hits)} " \
+    f"(misses: {len(misses)})"
+recompiles = [r for r in rows if r.get("event") == "recompile"]
+assert not recompiles, f"KV-engine traffic recompiled: {recompiles}"
+assert engine.n_recompiles == 0
+stats = engine.stats()
+assert stats["prefix_store"]["hits"] >= 7, stats
+warm = [r for r in rows if r.get("event") == "serve_warmup"][0]
+assert warm["kv_quant"] == "int8" and warm["prefill_chunk"] == 8, warm
+print(f"kv memory engine smoke ok: 8/8 requests, "
+      f"{len(hits)} prefix hits / {len(misses)} miss, int8 KV "
+      f"({warm['kv_bytes_per_slot']}B/slot), 0 recompiles")
+EOF
+
 echo "== serving drain smoke (SIGTERM + mid-run /metrics scrape, CPU) =="
 JAX_PLATFORMS=cpu python - <<'EOF' || exit 1
 import json, os, signal, socket, subprocess, sys, tempfile, time

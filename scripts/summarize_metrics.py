@@ -184,6 +184,7 @@ def summarize_serving(metrics, events):
              else "") + ")")
     summarize_serving_resilience(failed, shed, expired, events)
     summarize_adapters(done, failed, events)
+    summarize_prefix_kv(metrics, events)
     for key, label in (("queue_wait_s", "queue wait"), ("ttft_s", "TTFT"),
                        ("tpot_s", "TPOT"), ("e2e_s", "end-to-end")):
         vals = [e[key] for e in done
@@ -249,6 +250,71 @@ def summarize_adapters(done, failed, events):
             line += (f"  e2e p50 {1e3 * _pctile(t['e2e'], 50):8.2f} ms  "
                      f"p95 {1e3 * _pctile(t['e2e'], 95):8.2f} ms")
         print(line)
+
+
+def summarize_prefix_kv(metrics, events):
+    """KV memory-engine section (serving/kvcache.py): prefix-cache hit
+    ratio and bytes of prefill compute saved, the KV quant/chunk policy
+    from ``serve_warmup``, store churn (inserts/evictions), and the
+    chunk-stall table — the per-window prefill share of tick time that
+    chunked prefill exists to bound."""
+    hits = [e for e in events if e["event"] == "prefix_hit"]
+    misses = [e for e in events if e["event"] == "prefix_miss"]
+    evicts = [e for e in events if e["event"] == "prefix_evict"]
+    inserts = [e for e in events if e["event"] == "prefix_insert"]
+    warm = [e for e in events if e["event"] == "serve_warmup"]
+    policy = warm[-1] if warm else {}
+    chunked = bool(policy.get("prefill_chunk"))
+    if not (hits or misses or evicts or chunked
+            or policy.get("kv_quant", "model") != "model"):
+        return
+    print("  -- KV memory engine --")
+    print("  policy: kv_quant=" + str(policy.get("kv_quant", "model"))
+          + f", prefill_chunk={policy.get('prefill_chunk', 0)}"
+          + f", prefix_cache={policy.get('prefix_cache', False)}"
+          + (f", {policy.get('kv_bytes_per_slot', 0) / 1024 ** 2:.2f} "
+             "MiB KV/slot" if policy.get("kv_bytes_per_slot") else ""))
+    n_lookups = len(hits) + len(misses)
+    if n_lookups:
+        spans = [e.get("span_tokens", 0) for e in hits]
+        bps = policy.get("kv_bytes_per_slot")
+        max_len = policy.get("max_len")
+        saved = ""
+        if bps and max_len and spans:
+            # bytes of slot KV the hits filled by COPY instead of
+            # forward compute — the prefill work the cache deleted
+            saved_bytes = sum(spans) * (bps / max_len)
+            saved = f", ~{_fmt_bytes(int(saved_bytes))} of prefill KV " \
+                    "filled by copy"
+        print(f"  prefix cache: {len(hits)}/{n_lookups} lookups hit "
+              f"({100 * len(hits) / n_lookups:.0f}%), "
+              f"{sum(spans)} cached-span tokens skipped prefill{saved}")
+        print(f"  store churn: {len(inserts)} insert(s), "
+              f"{len(evicts)} eviction(s)"
+              + (f" ({_fmt_bytes(sum(e.get('bytes', 0) for e in evicts))}"
+                 " evicted)" if evicts else ""))
+    # chunk-stall table: windows where prefill dominated the tick —
+    # under chunking each entry is bounded by ~one chunk's wall
+    rows = [r for r in metrics
+            if isinstance(r.get("tick_prefill_s"), (int, float))
+            and isinstance(r.get("ticks_in_window"), (int, float))
+            and r["ticks_in_window"] > 0 and r.get("tick_prefill_s", 0) > 0]
+    if rows and chunked:
+        worst = sorted(rows, reverse=True,
+                       key=lambda r: r["tick_prefill_s"]
+                       / r["ticks_in_window"])[:5]
+        n_chunks = sum(r.get("prefill_chunks", 0) for r in rows)
+        print(f"  chunked prefill: {n_chunks} chunk(s) over "
+              f"{len(rows)} window(s); worst prefill-stall windows "
+              "(s/tick):")
+        for r in worst:
+            val = r["tick_prefill_s"] / r["ticks_in_window"]
+            share = (100 * r["tick_prefill_s"] / r["tick_total_s"]
+                     if r.get("tick_total_s") else 0.0)
+            print(f"    step {r.get('step', '?'):>8}  "
+                  f"{1e3 * val:8.3f} ms/tick  "
+                  f"({share:.0f}% of tick wall, "
+                  f"{r.get('prefill_chunks', 0)} chunks)")
 
 
 def summarize_ticks(metrics, events):
