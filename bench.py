@@ -9,7 +9,25 @@ Usage:
   python bench.py cfg5       # LLaMA2-7B-arch zero1 slice (BASELINE #5, see note)
   python bench.py trainer    # Trainer-loop path (vs raw-step, VERDICT r2 #3)
   python bench.py serve      # continuous-batching engine vs sequential decode
+  python bench.py micro_train  # debug-size perf-gate micro-bench (CI)
   python bench.py all        # everything, one JSON line each
+
+Runner flags (the perf observatory, obs/perf.py):
+  --repeats K   run each bench K times; the result row carries
+                min/median/mean/stddev repeat stats (timing-gate noise floor)
+  --json OUT    append schema'd BenchResult rows to OUT (JSONL; a
+                run-metadata header row is written first), or into
+                OUT/<name>.jsonl when OUT is a directory (trajectory layout)
+  --quick       shrink iteration/request counts (never shapes — the
+                structural fingerprint is quick-invariant); the CI gate mode
+
+Every bench returns an ``obs/perf.BenchResult``: headline value + unit,
+named extra metrics, the bench's arm-detail dict, and — filled by the
+runner — env metadata (jax version, backend, device kind/count, mesh, git
+sha, argv), repeat stats, and a structural HLO fingerprint (per-program
+cost-analysis FLOPs, memory breakdown, arg signatures, recompile count)
+captured via ``obs/compile.CompileWatcher``. ``scripts/perf_gate.py``
+compares those fingerprints against PERF_BASELINE.json in CI.
 
 The reference publishes NO numbers (BASELINE.md), so ``vs_baseline``
 compares against this repo's first recorded figure: headline/cfg1 against
@@ -23,6 +41,7 @@ recorded in the metric name) — the full-size sharding compiles+executes in
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import sys
@@ -30,6 +49,31 @@ import time
 
 import jax
 import numpy as np
+
+from building_llm_from_scratch_tpu.obs import perf
+
+#: --quick: shrink iteration/request counts so the CI perf gate finishes
+#: in seconds. NEVER shrinks shapes (batch size, context, slots) — the
+#: structural fingerprint must be identical in quick and full mode.
+_QUICK = False
+
+
+def _q_iters(warmup: int, iters: int):
+    """Quick-mode iteration budget: fewer timed steps, same shapes."""
+    if _QUICK:
+        return min(warmup, 1), min(iters, 4)
+    return warmup, iters
+
+
+def _result(name: str, metric: str, value, unit: str = "tokens/sec/chip",
+            mfu=None, detail=None) -> perf.BenchResult:
+    """Build the BenchResult every BENCHES entry returns (the old
+    ``(metric, value[, mfu])`` tuple contract, made schema'd)."""
+    res = perf.BenchResult(name=name, metric=metric, value=float(value),
+                           unit=unit, detail=detail)
+    if mfu is not None:
+        res.add_metric("mfu", round(float(mfu), 4), "fraction")
+    return res
 
 # First recorded tokens/sec/chip per config on TPU v5e-1 (BASELINE.md).
 RECORDED = {
@@ -71,13 +115,6 @@ def _device_specs():
         print(json.dumps({"warning": f"unknown TPU device kind '{kind}'; "
                           "MFU/roofline use v5e peak numbers"}), flush=True)
     return dict(_mfu.DEVICE_SPECS)["v5e"]
-
-
-# HLO-measured efficiency of the last _pretrain_tps step (obs/compile.py
-# AOT capture): cost-analysis FLOPs/step, compile seconds, FLOPs/token.
-# Reset per run() so BENCH_*.json lines carry an efficiency trajectory,
-# not just tok/s.
-LAST_HLO = {}
 
 
 def _model_flops_per_token(cfg, lora: bool = False) -> float:
@@ -155,25 +192,17 @@ def _pretrain_tps(cfg, batch_size, policy=None, warmup=3, iters=20,
         batch = plan.shard_batch(batch)
     step = make_train_step(cfg, opt, policy=policy, lora_rank=lora_rank,
                            lora_alpha=lora_alpha, grad_accum=grad_accum)
-    # AOT-compile the step (obs/compile.py) so the line carries XLA's own
-    # cost accounting next to the measured tok/s; the compiled executable
-    # is what gets timed (one compile either way)
-    global LAST_HLO
-    try:
-        from building_llm_from_scratch_tpu.obs.compile import aot_compile
+    # CompileWatcher-wrap the step (obs/compile.py): the AOT capture makes
+    # the line carry XLA's own cost accounting next to the measured tok/s
+    # (compile seconds, HLO FLOPs, HBM breakdown), an active
+    # FingerprintCollector (obs/perf.py) records it into the bench's
+    # structural fingerprint, and the timed executable is the AOT-compiled
+    # one (one compile either way; on capture failure the watcher falls
+    # back to the plain jit path itself).
+    from building_llm_from_scratch_tpu.obs.compile import CompileWatcher
 
-        compiled, stats = aot_compile(step, state, batch)
-        if stats.get("flops"):
-            LAST_HLO = {
-                "hlo_flops_per_step": stats["flops"],
-                "hlo_flops_per_token": stats["flops"] / (
-                    batch_size * cfg.context_length),
-                "compile_seconds": stats["compile_seconds"],
-            }
-        step = compiled
-    except Exception as e:
-        print(json.dumps({"warning": f"AOT capture failed ({e}); "
-                          "timing the implicit-jit path"}), flush=True)
+    step = CompileWatcher(step, label="bench_step")
+    warmup, iters = _q_iters(warmup, iters)
     dt = _time_steps(step, state, batch, warmup, iters)
     return batch_size * cfg.context_length * iters / dt / jax.device_count()
 
@@ -188,8 +217,8 @@ def bench_cfg1():
 
     cfg = get_config("GPT2", "124M", dtype="fp32")
     tps = _pretrain_tps(cfg, batch_size=4)
-    return ("tokens/sec/chip GPT2-124M pretrain fp32 bs4 ctx1024", tps,
-            _mfu(tps, cfg))
+    return _result("cfg1", "tokens/sec/chip GPT2-124M pretrain fp32 bs4 "
+                   "ctx1024", tps, mfu=_mfu(tps, cfg))
 
 
 def bench_headline():
@@ -205,8 +234,8 @@ def bench_headline():
 
     cfg = get_config("GPT2", "124M", dtype="fp32")
     tps = _pretrain_tps(cfg, batch_size=8, policy=get_policy("bf16"))
-    return ("tokens/sec/chip GPT2-124M pretrain bf16 bs8 ctx1024", tps,
-            _mfu(tps, cfg))
+    return _result("headline", "tokens/sec/chip GPT2-124M pretrain bf16 "
+                   "bs8 ctx1024", tps, mfu=_mfu(tps, cfg))
 
 
 def bench_cfg2():
@@ -217,8 +246,8 @@ def bench_cfg2():
     cfg = get_config("GPT2", "774M", dtype="bf16", use_actv_ckpt=True)
     tps = _pretrain_tps(cfg, batch_size=8, warmup=2, iters=10,
                         policy=get_policy("bf16"))
-    return ("tokens/sec/chip GPT2-774M pretrain bf16+remat bs8 ctx1024",
-            tps, _mfu(tps, cfg))
+    return _result("cfg2", "tokens/sec/chip GPT2-774M pretrain bf16+remat "
+                   "bs8 ctx1024", tps, mfu=_mfu(tps, cfg))
 
 
 def bench_cfg3():
@@ -234,8 +263,8 @@ def bench_cfg3():
     tps = _pretrain_tps(cfg, batch_size=8, warmup=2, iters=10,
                         policy=get_policy("bf16"), lora_rank=8,
                         lora_alpha=16, sft_mask=True)
-    return ("tokens/sec/chip LLaMA3.2-1B LoRA-r8 SFT bf16 bs8 ctx1024",
-            tps, _mfu(tps, cfg, lora=True))
+    return _result("cfg3", "tokens/sec/chip LLaMA3.2-1B LoRA-r8 SFT bf16 "
+                   "bs8 ctx1024", tps, mfu=_mfu(tps, cfg, lora=True))
 
 
 def bench_cfg4():
@@ -250,8 +279,8 @@ def bench_cfg4():
                      target_context_length=1024).replace(n_layers=2)
     tps = _pretrain_tps(cfg, batch_size=4, warmup=2, iters=10,
                         policy=get_policy("bf16"), shard_mode="fsdp")
-    return ("tokens/sec/chip LLaMA3-8B-arch[2/32 layers] SFT bf16 "
-            "fsdp bs4 ctx1024"), tps, _mfu(tps, cfg)
+    return _result("cfg4", "tokens/sec/chip LLaMA3-8B-arch[2/32 layers] "
+                   "SFT bf16 fsdp bs4 ctx1024", tps, mfu=_mfu(tps, cfg))
 
 
 def bench_cfg5():
@@ -265,8 +294,9 @@ def bench_cfg5():
                      target_context_length=1024).replace(n_layers=4)
     tps = _pretrain_tps(cfg, batch_size=4, warmup=2, iters=10,
                         policy=get_policy("bf16"), shard_mode="zero1")
-    return ("tokens/sec/chip LLaMA2-7B-arch[4/32 layers] pretrain bf16 "
-            "zero1 bs4 ctx1024"), tps, _mfu(tps, cfg)
+    return _result("cfg5", "tokens/sec/chip LLaMA2-7B-arch[4/32 layers] "
+                   "pretrain bf16 zero1 bs4 ctx1024", tps,
+                   mfu=_mfu(tps, cfg))
 
 
 def bench_accum():
@@ -281,8 +311,8 @@ def bench_accum():
     cfg = get_config("GPT2", "124M", dtype="fp32")
     tps = _pretrain_tps(cfg, batch_size=32, warmup=2, iters=10,
                         policy=get_policy("bf16"), grad_accum=4)
-    return ("tokens/sec/chip GPT2-124M pretrain bf16 bs32 grad_accum4",
-            tps, _mfu(tps, cfg))
+    return _result("accum", "tokens/sec/chip GPT2-124M pretrain bf16 bs32 "
+                   "grad_accum4", tps, mfu=_mfu(tps, cfg))
 
 
 def _trainer_run(n_steps=60, prefetch=0, async_ckpt=False, save_every=None):
@@ -297,6 +327,8 @@ def _trainer_run(n_steps=60, prefetch=0, async_ckpt=False, save_every=None):
     from building_llm_from_scratch_tpu.models import init_params
     from building_llm_from_scratch_tpu.training import Trainer, get_policy
 
+    if _QUICK:
+        n_steps = min(n_steps, 12)
     cfg = get_config("GPT2", "124M", dtype="fp32")
     params = init_params(cfg, jax.random.PRNGKey(0))
     tok = ByteTokenizer()
@@ -335,8 +367,9 @@ def bench_trainer(n_steps=60):
     """The Trainer-loop path (cadence work, metric tracking, data pipeline)
     — must be within ~5% of the raw-step headline (round-2 VERDICT #3).
     Runs with the CLI-default --prefetch 2 since the host-overlap round."""
-    tps, _ = _trainer_run(n_steps, prefetch=2)
-    return "tokens/sec/chip GPT2-124M Trainer-loop bf16 bs4 ctx1024", tps
+    tps, stats = _trainer_run(n_steps, prefetch=2)
+    return _result("trainer", "tokens/sec/chip GPT2-124M Trainer-loop bf16 "
+                   "bs4 ctx1024", tps, detail=stats)
 
 
 def bench_prefetch(n_steps=60):
@@ -353,14 +386,16 @@ def bench_prefetch(n_steps=60):
     tps_on, on = _trainer_run(n_steps, prefetch=2, async_ckpt=True,
                               save_every=save_every)
     wait_off = max(off["data_wait_s_per_step"], 1e-9)
-    print(json.dumps({
+    detail = {
         "prefetch_off": dict(off, tok_s=round(tps_off, 1)),
         "prefetch_on": dict(on, tok_s=round(tps_on, 1)),
         "data_wait_speedup": round(
             wait_off / max(on["data_wait_s_per_step"], 1e-9), 1),
-    }), flush=True)
-    return ("tokens/sec/chip GPT2-124M Trainer-loop prefetch2+async_ckpt "
-            "bf16 bs4 ctx1024", tps_on)
+    }
+    print(json.dumps(detail), flush=True)
+    return _result("prefetch", "tokens/sec/chip GPT2-124M Trainer-loop "
+                   "prefetch2+async_ckpt bf16 bs4 ctx1024", tps_on,
+                   detail=detail)
 
 
 def bench_decode(max_new=256):
@@ -379,6 +414,8 @@ def bench_decode(max_new=256):
     from building_llm_from_scratch_tpu.generate import generate
     from building_llm_from_scratch_tpu.models import init_params
 
+    if _QUICK:
+        max_new = min(max_new, 64)
     cfg = get_config("GPT2", "124M", dtype="bf16")
     params = init_params(cfg, jax.random.PRNGKey(0))
     param_bytes = sum(leaf.size * leaf.dtype.itemsize
@@ -413,18 +450,21 @@ def bench_decode(max_new=256):
         assert o.shape[1] - prompt.shape[1] == budget
         return best
 
-    t_low, t_high = best_wall(128), best_wall(384)
-    dev_steps_s = (384 - 128) / max(t_high - t_low, 1e-9)
-    print(json.dumps({
+    lo, hi = (32, 96) if _QUICK else (128, 384)
+    t_low, t_high = best_wall(lo), best_wall(hi)
+    dev_steps_s = (hi - lo) / max(t_high - t_low, 1e-9)
+    detail = {
         "decode_per_seq_tok_s": round(n_steps / dt, 1),
         "decode_pct_of_weight_stream_roofline":
             round(100 * (n_steps / dt) / roofline_steps, 1),
         "decode_device_per_seq_tok_s": round(dev_steps_s, 1),
         "decode_device_pct_of_weight_stream_roofline":
             round(100 * dev_steps_s / roofline_steps, 1),
-    }), flush=True)
-    return ("decode tokens/sec GPT2-124M bf16 bs8 kv-cache greedy",
-            n_tok / dt)
+    }
+    print(json.dumps(detail), flush=True)
+    return _result("decode", "decode tokens/sec GPT2-124M bf16 bs8 "
+                   "kv-cache greedy", n_tok / dt, unit="tokens/sec",
+                   detail=detail)
 
 
 def bench_serve(n_requests=8, max_new=32, prompt_len=16):
@@ -447,6 +487,8 @@ def bench_serve(n_requests=8, max_new=32, prompt_len=16):
         SamplingParams,
     )
 
+    if _QUICK:
+        n_requests, max_new = min(n_requests, 4), min(max_new, 8)
     dtype = "bf16" if jax.default_backend() == "tpu" else "fp32"
     cfg = get_config("GPT2", "124M", dtype=dtype)
     params = init_params(cfg, jax.random.PRNGKey(0))
@@ -501,8 +543,9 @@ def bench_serve(n_requests=8, max_new=32, prompt_len=16):
             engine_at_4 = tok_s
         engine.shutdown()
     print(json.dumps(detail), flush=True)
-    return (f"serve tokens/sec GPT2-124M {dtype} {n_requests}req x "
-            f"{max_new}new continuous-batching slots4", engine_at_4)
+    return _result("serve", f"serve tokens/sec GPT2-124M {dtype} "
+                   f"{n_requests}req x {max_new}new continuous-batching "
+                   "slots4", engine_at_4, unit="tokens/sec", detail=detail)
 
 
 def bench_serve_load(n_slots=4, max_new=24, prompt_len=16,
@@ -544,6 +587,8 @@ def bench_serve_load(n_slots=4, max_new=24, prompt_len=16,
         RequestExpiredError,
     )
 
+    if _QUICK:
+        n_requests, max_new = min(n_requests, 12), min(max_new, 8)
     dtype = "bf16" if jax.default_backend() == "tpu" else "fp32"
     cfg = get_config("GPT2", "124M", dtype=dtype)
     params = init_params(cfg, jax.random.PRNGKey(0))
@@ -635,9 +680,10 @@ def bench_serve_load(n_slots=4, max_new=24, prompt_len=16,
         if load == 1.0:
             completed_at_1x = done / dt
     print(json.dumps(detail), flush=True)
-    return (f"serve offered-load sweep GPT2-124M {dtype} {n_requests}req "
-            f"poisson slots{n_slots} completed-rps@1.0x",
-            completed_at_1x * max_new)
+    return _result("serve_load", f"serve offered-load sweep GPT2-124M "
+                   f"{dtype} {n_requests}req poisson slots{n_slots} "
+                   "completed-rps@1.0x", completed_at_1x * max_new,
+                   unit="tokens/sec", detail=detail)
 
 
 def bench_serve_lora(n_adapters=3, n_requests=16, max_new=24,
@@ -668,6 +714,8 @@ def bench_serve_lora(n_adapters=3, n_requests=16, max_new=24,
         SamplingParams,
     )
 
+    if _QUICK:
+        n_requests, max_new = min(n_requests, 8), min(max_new, 8)
     dtype = "bf16" if jax.default_backend() == "tpu" else "fp32"
     cfg = get_config("GPT2", "124M", dtype=dtype)
     params = init_params(cfg, jax.random.PRNGKey(0))
@@ -724,9 +772,10 @@ def bench_serve_lora(n_adapters=3, n_requests=16, max_new=24,
         "recompiles": 0,
     }
     print(json.dumps(detail), flush=True)
-    return (f"serve_lora tokens/sec GPT2-124M {dtype} {n_requests}req x "
-            f"{max_new}new {n_adapters}adapters+base slots{n_slots}",
-            mixed_tok_s)
+    return _result("serve_lora", f"serve_lora tokens/sec GPT2-124M {dtype} "
+                   f"{n_requests}req x {max_new}new {n_adapters}adapters"
+                   f"+base slots{n_slots}", mixed_tok_s,
+                   unit="tokens/sec", detail=detail)
 
 
 def bench_serve_prefix(n_requests=10, prefix_len=192, suffix_len=8,
@@ -764,6 +813,8 @@ def bench_serve_prefix(n_requests=10, prefix_len=192, suffix_len=8,
         SamplingParams,
     )
 
+    if _QUICK:
+        n_requests, max_new = min(n_requests, 6), min(max_new, 8)
     dtype = "bf16" if jax.default_backend() == "tpu" else "fp32"
     cfg = get_config("GPT2", "124M", dtype=dtype)
     params = init_params(cfg, jax.random.PRNGKey(0))
@@ -824,9 +875,78 @@ def bench_serve_prefix(n_requests=10, prefix_len=192, suffix_len=8,
         detail["tick_prefill_p95_ratio_chunked"] = round(
             ch["tick_prefill_p95_s"] / un["tick_prefill_p95_s"], 3)
     print(json.dumps(detail), flush=True)
-    return (f"serve_prefix tokens/sec GPT2-124M {dtype} {n_requests}req "
-            f"shared-{prefix_len}tok-prefix chunk{chunk} prefix-cache",
-            headline)
+    return _result("serve_prefix", f"serve_prefix tokens/sec GPT2-124M "
+                   f"{dtype} {n_requests}req shared-{prefix_len}tok-prefix "
+                   f"chunk{chunk} prefix-cache", headline,
+                   unit="tokens/sec", detail=detail)
+
+
+# ---------------------------------------------------------------------------
+# Micro-benches: the CI perf-gate workloads (scripts/perf_gate.py)
+# ---------------------------------------------------------------------------
+
+def bench_micro_train():
+    """Debug-size GPT2 raw train step (ctx 16, emb 32, 2 layers): seconds
+    on CPU, so the structural perf gate can run it on every CI pass. The
+    tok/s number is meaningless as throughput — what matters is the
+    fingerprint: the step's HLO FLOPs, program count and HBM breakdown
+    must match PERF_BASELINE.json exactly."""
+    from building_llm_from_scratch_tpu.configs import get_config
+
+    cfg = get_config("GPT2", "124M", dtype="fp32", debug=True)
+    tps = _pretrain_tps(cfg, batch_size=4, warmup=1, iters=4)
+    return _result("micro_train", "tokens/sec GPT2-debug pretrain fp32 "
+                   "bs4 ctx16", tps, unit="tokens/sec")
+
+
+def bench_micro_accum():
+    """Debug-size grad-accum step (2 scanned microbatches): a second,
+    structurally DIFFERENT program for the gate — accumulation bugs that
+    change the compiled graph (a dropped scan, a dtype drift in the
+    accumulator) show up as a FLOP/memory diff here."""
+    from building_llm_from_scratch_tpu.configs import get_config
+
+    cfg = get_config("GPT2", "124M", dtype="fp32", debug=True)
+    tps = _pretrain_tps(cfg, batch_size=8, warmup=1, iters=4, grad_accum=2)
+    return _result("micro_accum", "tokens/sec GPT2-debug pretrain fp32 "
+                   "bs8 grad_accum2 ctx16", tps, unit="tokens/sec")
+
+
+def bench_micro_serve():
+    """Debug-size continuous-batching engine (2 slots, 6 requests): the
+    gate workload for the serving tier — its fingerprint covers the
+    engine's whole compiled-program family (bucketed prefill + decode),
+    so a bucket-set change, an extra program, or a warmup recompile
+    fails the structural gate with the program named."""
+    from building_llm_from_scratch_tpu.configs import get_config
+    from building_llm_from_scratch_tpu.models import init_params
+    from building_llm_from_scratch_tpu.serving import (
+        DecodeEngine,
+        SamplingParams,
+    )
+
+    n_requests, max_new, prompt_len = 6, 4, 4
+    cfg = get_config("GPT2", "124M", dtype="fp32", debug=True)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(0, cfg.vocab_size,
+                           (n_requests, prompt_len)).astype(np.int32)
+    sp = SamplingParams(max_new_tokens=max_new, ignore_eos=True)
+    engine = DecodeEngine(cfg, params, n_slots=2, max_queue=n_requests,
+                          warmup_prompt_cap=prompt_len, metrics_every=2)
+    engine.warmup()
+    t0 = time.perf_counter()
+    handles = [engine.submit(p, sp, block=True) for p in prompts]
+    engine.run_until_idle()
+    dt = time.perf_counter() - t0
+    for h in handles:
+        assert len(h.output_ids) == max_new, h.finish_reason
+    detail = {"recompiles": engine.n_recompiles}
+    engine.shutdown()
+    return _result("micro_serve", "serve tokens/sec GPT2-debug fp32 "
+                   f"{n_requests}req x {max_new}new slots2",
+                   n_requests * max_new / dt, unit="tokens/sec",
+                   detail=detail)
 
 
 BENCHES = {
@@ -844,36 +964,129 @@ BENCHES = {
     "serve_load": bench_serve_load,
     "serve_lora": bench_serve_lora,
     "serve_prefix": bench_serve_prefix,
+    "micro_train": bench_micro_train,
+    "micro_accum": bench_micro_accum,
+    "micro_serve": bench_micro_serve,
 }
 
+#: Micro-benches excluded from ``all`` (they are gate workloads, not
+#: performance claims — their tok/s on a debug model means nothing).
+MICRO_BENCHES = ("micro_train", "micro_accum", "micro_serve")
 
-def run(name: str):
-    global LAST_HLO
-    LAST_HLO = {}
-    out = BENCHES[name]()
-    metric, tps = out[0], out[1]
-    mfu = out[2] if len(out) > 2 else None
+
+def run_bench(name: str, repeats: int = 1, quick: bool = False
+              ) -> perf.BenchResult:
+    """Run one bench ``repeats`` times; returns the final repeat's
+    BenchResult carrying repeat stats over the headline values, the env
+    block, and the structural fingerprint (obs/perf.py). The programmatic
+    entry the perf gate uses — ``run()`` is the printing CLI wrapper."""
+    global _QUICK
+    prev_quick, _QUICK = _QUICK, bool(quick)
+    fn = BENCHES[name]
+    try:
+        values, results, digests = [], [], []
+        for _ in range(max(1, int(repeats))):
+            with perf.FingerprintCollector() as col:
+                res = fn()
+            if not isinstance(res, perf.BenchResult):
+                raise TypeError(f"bench '{name}' must return a BenchResult,"
+                                f" got {type(res).__name__}")
+            res.fingerprint = col.fingerprint()
+            digests.append(perf.fingerprint_digest(res.fingerprint))
+            values.append(res.value)
+            results.append(res)
+    finally:
+        _QUICK = prev_quick
+    final = results[-1]
+    final.repeats = perf.repeat_stats(values)
+    # a fingerprint that drifts BETWEEN repeats of the same bench is a
+    # nondeterministic compile (data-dependent shapes, a cache-warmup
+    # recompile) — exactly what the gate exists to catch, so record it
+    final.fingerprint["stable_across_repeats"] = len(set(digests)) == 1
+    final.env = perf.bench_env()
+    final.quick = bool(quick)
+    final.time = time.time()
     rec = RECORDED.get(name)
+    final.vs_baseline = round(final.value / rec, 3) if rec else None
+    perf.emit_bench_result(final)
+    return final
+
+
+def _legacy_line(res: perf.BenchResult) -> dict:
+    """The one-JSON-line stdout format the BENCH_r0N driver snapshots
+    parse: metric/value/unit/vs_baseline (+mfu and the HLO efficiency
+    fields when the capture produced them)."""
     line = {
-        "metric": metric,
-        "value": round(tps, 1),
-        "unit": "tokens/sec/chip",
-        "vs_baseline": round(tps / rec, 3) if rec else 1.0,
+        "metric": res.metric,
+        "value": round(res.value, 1),
+        "unit": res.unit,
+        "vs_baseline": res.vs_baseline if res.vs_baseline is not None
+        else 1.0,
     }
+    mfu = res.metric_value("mfu")
     if mfu is not None:
         line["mfu"] = round(mfu, 3)
-    if LAST_HLO.get("hlo_flops_per_token"):
+    fp = res.fingerprint or {}
+    # the chronologically LAST bench_step capture is the executable the
+    # timed loop actually ran (after any mid-run recompile); the sorted
+    # programs list is the deterministic fallback
+    last = fp.get("last_program")
+    step_progs = [p for p in ([last] if last else [])
+                  + list(fp.get("programs", ()))
+                  if p["label"] == "bench_step" and p.get("flops")]
+    if step_progs:
         from building_llm_from_scratch_tpu.obs.mfu import mfu_from_flops
 
-        line["hlo_flops_per_step"] = LAST_HLO["hlo_flops_per_step"]
-        line["compile_seconds"] = round(LAST_HLO["compile_seconds"], 2)
-        # per-chip tps against the same fallback peak _mfu uses, but with
-        # XLA's counted FLOPs — the delta vs "mfu" is formula drift
-        mfu_hlo = mfu_from_flops(tps, LAST_HLO["hlo_flops_per_token"],
-                                 n_devices=1, peak=_device_specs()[0])
-        if mfu_hlo is not None:
-            line["mfu_hlo"] = round(mfu_hlo, 3)
-    print(json.dumps(line), flush=True)
+        prog = step_progs[0]
+        line["hlo_flops_per_step"] = prog["flops"]
+        compile_s = (res.fingerprint.get("timing") or {}).get(
+            "compile_seconds_total")
+        if compile_s is not None:
+            line["compile_seconds"] = round(compile_s, 2)
+        if prog.get("tokens_per_step"):
+            # per-chip tps against the same fallback peak _mfu uses, but
+            # with XLA's counted FLOPs — the delta vs "mfu" is formula
+            # drift
+            mfu_hlo = mfu_from_flops(
+                res.value, prog["flops"] / prog["tokens_per_step"],
+                n_devices=1, peak=_device_specs()[0])
+            if mfu_hlo is not None:
+                line["mfu_hlo"] = round(mfu_hlo, 3)
+    if res.repeats and res.repeats.get("n", 1) > 1:
+        line["repeats"] = {k: res.repeats[k]
+                           for k in ("n", "min", "median", "stddev")}
+    return line
+
+
+def run(name: str, repeats: int = 1, quick: bool = False,
+        json_out=None) -> perf.BenchResult:
+    res = run_bench(name, repeats=repeats, quick=quick)
+    print(json.dumps(_legacy_line(res)), flush=True)
+    if json_out is not None:
+        json_out.write(json.dumps(res.to_row(), sort_keys=True) + "\n")
+        json_out.flush()
+    return res
+
+
+def _open_json_out(path: str, name: str):
+    """``--json`` sink: a directory gets the trajectory layout (one
+    ``<name>.jsonl`` per bench, appended — the results/perf convention);
+    a file path gets every row plus one run-metadata header. A
+    not-yet-existing extensionless path (``--json results/perf``) is
+    treated as a directory — writing a FILE named like the intended
+    trajectory dir would break every later store open against it."""
+    if (os.path.isdir(path) or path.endswith(os.sep)
+            or "." not in os.path.basename(path)):
+        store = perf.TrajectoryStore(path.rstrip(os.sep))
+        os.makedirs(store.root, exist_ok=True)
+        return open(store.path(name), "a")
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    f = open(path, "a")
+    if f.tell() == 0:
+        f.write(json.dumps(perf.header_row(), sort_keys=True) + "\n")
+    return f
 
 
 def main(argv):
@@ -881,13 +1094,44 @@ def main(argv):
         configure_default_prng,
     )
 
+    p = argparse.ArgumentParser(
+        description="bench runner (see module docstring)")
+    p.add_argument("which", nargs="?", default="headline",
+                   help="bench name from BENCHES, or 'all'")
+    p.add_argument("--repeats", type=int, default=1, metavar="K",
+                   help="repeat each bench K times; rows carry "
+                        "min/median/stddev stats")
+    p.add_argument("--json", default=None, metavar="OUT",
+                   help="append BenchResult JSONL rows to OUT (a "
+                        "*.json/*.jsonl file gets rows + one header; "
+                        "anything else is a directory and gets the "
+                        "results/perf one-file-per-bench trajectory "
+                        "layout)")
+    p.add_argument("--quick", action="store_true",
+                   help="shrink iteration counts (CI gate mode; shapes — "
+                        "and so fingerprints — are unchanged)")
+    args = p.parse_args(argv[1:])
+
     configure_default_prng()   # rbg PRNG: dropout at full speed (seeding.py)
-    which = argv[1] if len(argv) > 1 else "headline"
-    if which == "all":
-        for name in BENCHES:
-            run(name)
-    else:
-        run(which)
+    # run-metadata header FIRST (jax version, backend, device kind/count,
+    # git sha, argv): the BENCH_*.json driver snapshots capture stdout, so
+    # every archived bench line is self-describing about where it ran
+    print(json.dumps(perf.header_row(), sort_keys=True), flush=True)
+    names = list(BENCHES) if args.which == "all" else [args.which]
+    if args.which == "all":
+        names = [n for n in names if n not in MICRO_BENCHES]
+    for name in names:
+        if name not in BENCHES:
+            p.error(f"unknown bench '{name}' "
+                    f"(choose from {', '.join(BENCHES)})")
+        json_out = (_open_json_out(args.json, name)
+                    if args.json else None)
+        try:
+            run(name, repeats=args.repeats, quick=args.quick,
+                json_out=json_out)
+        finally:
+            if json_out is not None:
+                json_out.close()
 
 
 if __name__ == "__main__":

@@ -22,7 +22,12 @@ Entry points:
     aggregation: fixed-bucket latency histograms, rolling SLO burn-rate
     window, Prometheus text exposition (obs/metrics.py);
   - ``chrome_trace`` / ``export_chrome_trace`` / ``TICK_PHASES`` —
-    metrics-JSONL -> Chrome trace-event JSON for Perfetto (obs/trace.py).
+    metrics-JSONL -> Chrome trace-event JSON for Perfetto (obs/trace.py);
+  - ``BenchResult`` / ``FingerprintCollector`` / ``TrajectoryStore`` /
+    ``compare_structural`` / ``compare_timing`` — the perf observatory:
+    schema'd bench results with env + structural HLO fingerprints, the
+    results/perf trajectory store, and the two perf-gate comparison
+    modes (obs/perf.py; gated by scripts/perf_gate.py).
 """
 
 from building_llm_from_scratch_tpu.obs.compile import (
@@ -61,6 +66,15 @@ from building_llm_from_scratch_tpu.obs.mfu import (
     format_mfu,
     mfu_from_flops,
 )
+from building_llm_from_scratch_tpu.obs.perf import (
+    BenchResult,
+    FingerprintCollector,
+    TrajectoryStore,
+    bench_env,
+    compare_structural,
+    compare_timing,
+    fingerprint_digest,
+)
 from building_llm_from_scratch_tpu.obs.stall import StallDetector
 from building_llm_from_scratch_tpu.obs.timeline import (
     NON_STEP_SEGMENTS,
@@ -96,6 +110,13 @@ __all__ = [
     "group_health",
     "group_names",
     "health_summary_line",
+    "BenchResult",
+    "FingerprintCollector",
+    "TrajectoryStore",
+    "bench_env",
+    "compare_structural",
+    "compare_timing",
+    "fingerprint_digest",
     "StallDetector",
     "NON_STEP_SEGMENTS",
     "StepTimeline",

@@ -40,6 +40,36 @@ from building_llm_from_scratch_tpu.utils.logging import setup_logger
 
 logger = setup_logger(__name__)
 
+#: Active fingerprint collectors (obs/perf.FingerprintCollector installs
+#: itself here for the duration of one bench run): every CompileWatcher
+#: capture/recompile is reported to each, so a bench's structural
+#: fingerprint covers EVERY watched program that compiled while it ran —
+#: the trainer step and all five serving-engine programs alike.
+_collectors: List[Any] = []
+
+
+def add_collector(collector: Any) -> None:
+    """Register a fingerprint collector (``on_compile(label, sig, stats,
+    n_tokens=)`` / ``on_recompile(label, diff)`` duck type)."""
+    _collectors.append(collector)
+
+
+def remove_collector(collector: Any) -> None:
+    try:
+        _collectors.remove(collector)
+    except ValueError:
+        pass
+
+
+def _notify_collectors(method: str, *args, **kw) -> None:
+    # observation must never take down the observed program
+    for c in list(_collectors):
+        try:
+            getattr(c, method)(*args, **kw)
+        except Exception as e:            # pragma: no cover - collector bug
+            logger.warning("fingerprint collector %s failed: %s", method, e)
+
+
 #: memory_analysis() attributes surfaced in the compile event (bytes).
 _MEMORY_FIELDS = (
     ("argument_size_in_bytes", "args_bytes"),
@@ -341,6 +371,8 @@ class CompileWatcher:
             # deltas instead of guessing from timing
             event["cache_hit"] = (entries_after == entries_before
                                   and entries_before > 0)
+        _notify_collectors("on_compile", self.label, sig, stats,
+                           n_tokens=n_tokens)
         sink = get_metrics()
         sink.event("compile", **event)
         sink.gauge("compile_seconds_total",
@@ -387,6 +419,7 @@ class CompileWatcher:
                 sink.event("recompile", label=self.label,
                            n_recompiles=self.n_recompiles,
                            n_changed_leaves=len(diff), diff=diff[:50])
+                _notify_collectors("on_recompile", self.label, diff)
                 sink.gauge("recompile_count", self.n_recompiles)
                 leaves = [d["leaf"] for d in diff]
                 shown = "; ".join(leaves[:6]) + (

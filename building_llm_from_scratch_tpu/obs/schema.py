@@ -35,7 +35,7 @@ from typing import Any, Dict, FrozenSet, List
 
 #: Bump when a row type or a load-bearing field changes meaning. The
 #: ``header`` row carries it; consumers key parsing decisions on it.
-SCHEMA_VERSION = 4          # v4: + prefix_* events, prefix_copy tick phase
+SCHEMA_VERSION = 5          # v5: + bench_result event (perf observatory)
 
 #: JSONL row discriminators (the ``type`` field).
 ROW_TYPES = ("header", "metrics", "health", "event", "span")
@@ -218,6 +218,14 @@ _EVENT_LIST: List[EventSpec] = [
           optional=("span_tokens", "bytes", "entries", "adapter"),
           doc="a completed prefill's chunk-aligned prefix pane entered "
               "the store"),
+    # -- perf observatory -------------------------------------------------
+    _spec("bench_result", required=("name",),
+          optional=("metric", "value", "unit", "n_repeats", "quick",
+                    "fingerprint_sha"),
+          doc="one BenchResult landed (obs/perf.py): a bench arm's "
+              "metrics JSONL records what it measured, so the perf "
+              "gate's differential diagnosis can join telemetry to "
+              "the bench row it belongs to"),
     # -- serving: engine lifecycle ----------------------------------------
     _spec("serve_warmup",
           optional=("n_prefill_buckets", "buckets", "seconds", "n_slots",

@@ -322,6 +322,16 @@ print(f"drain smoke ok (signaled={signaled}): {len(results)} results all "
       "complete, clean exit 0, 0 recompiles")
 EOF
 
+echo "== perf observatory gate (structural, timing-free, CPU) =="
+# The three debug-size micro-benches' structural HLO fingerprints —
+# per-program cost-analysis FLOPs, compiled-program count, arg
+# signatures, recompile count, HBM breakdown — must match the checked-in
+# PERF_BASELINE.json exactly. Deterministic on CPU (no timing enters the
+# comparison), so a forced recompile or FLOP growth in the train step /
+# serving engine fails CI with the offending program named. Re-baseline
+# (with a reason) via: scripts/perf_gate.py --update-baseline --reason …
+JAX_PLATFORMS=cpu python scripts/perf_gate.py || exit 1
+
 echo "== tier-1 tests (ROADMAP.md) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

@@ -521,6 +521,144 @@ def summarize_health(health, top_k: int = 6):
                   f"min {g_lo} {r_lo:.2e}")
 
 
+# ---------------------------------------------------------------------------
+# Paired A/B compare (--compare a.jsonl b.jsonl): the delta view the perf
+# gate's differential diagnosis reuses (scripts/perf_gate.py)
+# ---------------------------------------------------------------------------
+
+def run_stats(path):
+    """Comparable summary statistics of one metrics JSONL: train
+    step-timeline segments (s/step), engine tick phases (s/tick p50/p95),
+    request-latency percentiles, throughput, compile totals. Only
+    sections the file actually has appear — a train run compares on
+    segments, a serve run on tick phases and latencies."""
+    header, metrics, events, _health = load_rows(path)
+    stats = {"path": path, "n_metric_rows": len(metrics),
+             "n_events": len(events)}
+    segs = {}
+    for seg in SCHEMA.TRAIN_SEGMENTS:
+        rows = [r for r in metrics
+                if isinstance(r.get(f"{seg}_s"), (int, float))]
+        if rows:
+            total = sum(r[f"{seg}_s"] for r in rows)
+            steps = sum(r["steps_in_window"] for r in rows
+                        if isinstance(r.get("steps_in_window"),
+                                      (int, float)))
+            segs[seg] = total / max(steps, 1)
+    if segs:
+        stats["train_segments_s_per_step"] = segs
+    _, tok = column(metrics, "tok_s")
+    if tok:
+        stats["tok_s_mean"] = sum(tok) / len(tok)
+    tick_rows = [r for r in metrics
+                 if isinstance(r.get("ticks_in_window"), (int, float))
+                 and r["ticks_in_window"] > 0]
+    ticks = {}
+    for ph in tuple(SCHEMA.TICK_PHASES) + ("total",):
+        key = "tick_total_s" if ph == "total" else f"tick_{ph}_s"
+        per_tick = [r[key] / r["ticks_in_window"] for r in tick_rows
+                    if isinstance(r.get(key), (int, float))]
+        if per_tick:
+            ticks[ph] = {"p50": _pctile(per_tick, 50),
+                         "p95": _pctile(per_tick, 95),
+                         "mean": sum(per_tick) / len(per_tick)}
+    if ticks:
+        stats["tick_phases_s_per_tick"] = ticks
+        stats["n_ticks"] = int(sum(r["ticks_in_window"] for r in tick_rows))
+    done = [e for e in events if e.get("event") == "request_done"]
+    lat = {}
+    for key in ("queue_wait_s", "ttft_s", "tpot_s", "e2e_s"):
+        vals = [e[key] for e in done
+                if isinstance(e.get(key), (int, float))]
+        if vals:
+            lat[key] = {"p50": _pctile(vals, 50), "p95": _pctile(vals, 95),
+                        "p99": _pctile(vals, 99)}
+    if lat:
+        stats["latency"] = lat
+        stats["n_done"] = len(done)
+    compiles = [e for e in events if e.get("event") == "compile"
+                and isinstance(e.get("compile_seconds"), (int, float))]
+    if compiles:
+        stats["compile_seconds_total"] = sum(e["compile_seconds"]
+                                             for e in compiles)
+        stats["n_compiles"] = len(compiles)
+    stats["n_recompiles"] = sum(1 for e in events
+                                if e.get("event") == "recompile")
+    return stats
+
+
+def _delta_txt(a, b):
+    if not isinstance(a, (int, float)) or not isinstance(b, (int, float)):
+        return "n/a"
+    if a == 0:
+        return f"{b - a:+.4g}"
+    return f"{100 * (b - a) / a:+.1f}%"
+
+
+def compare_runs(a_path, b_path, out=None):
+    """Paired A/B delta view over two metrics JSONLs. Returns
+    {"a": stats, "b": stats}; prints the aligned delta table (B relative
+    to A) for every section both files carry."""
+    write = (out or sys.stdout).write
+    A, B = run_stats(a_path), run_stats(b_path)
+    write(f"== A/B compare ==\n  A: {a_path}\n  B: {b_path}\n")
+    if "tok_s_mean" in A or "tok_s_mean" in B:
+        a, b = A.get("tok_s_mean"), B.get("tok_s_mean")
+        write(f"  throughput mean: A {a and round(a, 1)} "
+              f"B {b and round(b, 1)} tok/s  {_delta_txt(a, b)}\n")
+    seg_a = A.get("train_segments_s_per_step", {})
+    seg_b = B.get("train_segments_s_per_step", {})
+    if seg_a or seg_b:
+        write("  -- train step segments (ms/step) --\n")
+        for seg in SCHEMA.TRAIN_SEGMENTS:
+            a, b = seg_a.get(seg), seg_b.get(seg)
+            if a is None and b is None:
+                continue
+            write(f"    {seg:<12} A {1e3 * a:9.3f}  B {1e3 * b:9.3f}  "
+                  f"{_delta_txt(a, b)}\n"
+                  if a is not None and b is not None else
+                  f"    {seg:<12} A {a}  B {b}\n")
+    tick_a = A.get("tick_phases_s_per_tick", {})
+    tick_b = B.get("tick_phases_s_per_tick", {})
+    if tick_a or tick_b:
+        write(f"  -- engine tick phases (ms/tick p50; A {A.get('n_ticks')}"
+              f" ticks, B {B.get('n_ticks')} ticks) --\n")
+        for ph in tuple(SCHEMA.TICK_PHASES) + ("total",):
+            a, b = tick_a.get(ph), tick_b.get(ph)
+            if a is None and b is None:
+                continue
+            if a is not None and b is not None:
+                write(f"    {ph:<16} A {1e3 * a['p50']:9.3f}  "
+                      f"B {1e3 * b['p50']:9.3f}  "
+                      f"{_delta_txt(a['p50'], b['p50'])}"
+                      f"   (p95 {_delta_txt(a['p95'], b['p95'])})\n")
+            else:
+                write(f"    {ph:<16} only in "
+                      f"{'A' if a is not None else 'B'}\n")
+    lat_a, lat_b = A.get("latency", {}), B.get("latency", {})
+    if lat_a or lat_b:
+        write(f"  -- request latency (ms; A {A.get('n_done')} done, "
+              f"B {B.get('n_done')} done) --\n")
+        for key in ("queue_wait_s", "ttft_s", "tpot_s", "e2e_s"):
+            a, b = lat_a.get(key), lat_b.get(key)
+            if a is None or b is None:
+                continue
+            write(f"    {key:<12} p50 A {1e3 * a['p50']:9.2f}  "
+                  f"B {1e3 * b['p50']:9.2f}  "
+                  f"{_delta_txt(a['p50'], b['p50'])}"
+                  f"   (p95 {_delta_txt(a['p95'], b['p95'])}, "
+                  f"p99 {_delta_txt(a['p99'], b['p99'])})\n")
+    if A.get("n_compiles") or B.get("n_compiles"):
+        write(f"  compiles: A {A.get('n_compiles', 0)} "
+              f"({A.get('compile_seconds_total', 0):.2f}s)  "
+              f"B {B.get('n_compiles', 0)} "
+              f"({B.get('compile_seconds_total', 0):.2f}s)\n")
+    if A.get("n_recompiles") or B.get("n_recompiles"):
+        write(f"  !! recompiles: A {A.get('n_recompiles', 0)}  "
+              f"B {B.get('n_recompiles', 0)}\n")
+    return {"a": A, "b": B}
+
+
 def plot(metrics, out_path):
     try:
         import matplotlib
@@ -574,7 +712,8 @@ def plot(metrics, out_path):
 
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("jsonl", help="metrics JSONL written by --metrics_jsonl")
+    p.add_argument("jsonl", nargs="?", default=None,
+                   help="metrics JSONL written by --metrics_jsonl")
     p.add_argument("--out", default=None,
                    help="figure path (default: <jsonl dir>/metrics.png)")
     p.add_argument("--trace", default=None, metavar="TRACE_JSON",
@@ -582,7 +721,18 @@ def main(argv=None):
                         "(request span trees, engine tick windows, train "
                         "step windows, incidents) — load it at "
                         "https://ui.perfetto.dev")
+    p.add_argument("--compare", nargs=2, default=None,
+                   metavar=("A_JSONL", "B_JSONL"),
+                   help="paired A/B delta view over two runs: train "
+                        "step-timeline segments, engine tick phases, "
+                        "request-latency percentiles (the view the perf "
+                        "gate's differential diagnosis reuses)")
     args = p.parse_args(argv)
+    if args.compare:
+        compare_runs(*args.compare)
+        return
+    if not args.jsonl:
+        p.error("a metrics JSONL path is required (or use --compare A B)")
     header, metrics, events, health = load_rows(args.jsonl)
     summarize(header, metrics, events)
     summarize_compile(metrics, events)
