@@ -881,6 +881,157 @@ def bench_serve_prefix(n_requests=10, prefix_len=192, suffix_len=8,
                    unit="tokens/sec", detail=detail)
 
 
+def _fleet_batches(cfg, k, rows, seed=0):
+    """Per-job synthetic SFT batches (random tokens, Alpaca-style
+    prompt-half loss mask) — the same rows feed both A/B arms."""
+    rng = np.random.default_rng(seed)
+    T = cfg.context_length
+    out = []
+    for _ in range(k):
+        w = np.ones((rows, T), np.float32)
+        w[:, : T // 2] = 0.0
+        out.append({
+            "inputs": rng.integers(0, cfg.vocab_size,
+                                   (rows, T)).astype(np.int32),
+            "targets": rng.integers(0, cfg.vocab_size,
+                                    (rows, T)).astype(np.int32),
+            "weights": w,
+        })
+    return out
+
+
+def bench_lora_fusion(k=4, rows=2, rank=4, n_steps=12):
+    """Fused multi-LoRA training A/B (training/lora_fusion.py): train the
+    SAME k jobs (identical per-job batches, rank, hyperparameters)
+    (a) the pre-fusion way — k sequential solo LoRA finetune runs, each
+    its own merged-weights train step, its own XLA compile, its own
+    dispatch stream — vs (b) ONE fused run whose step carries all k
+    jobs' rows with per-row job_ids, gradients flowing only to the
+    stacked adapter pool.
+
+    Debug-size on CPU (the micro-bench convention), sized like real
+    tenant jobs: small per-job batches, short horizons. The HEADLINE is
+    aggregate adapter-training throughput for the WHOLE FLEET — fleet
+    tokens / fleet wall, where each solo finetune is a fresh run and so
+    pays its own compile (that is what 'k sequential solo finetunes'
+    costs; the fused service compiles once, ever, and every later tenant
+    hot-joins the same program). Also reported: steady-state tok/s per
+    arm (compile excluded — on CPU this is compute-bound and near-even;
+    the fused win there is the HLO FLOPs line, not wall), and the HLO
+    cost-analysis FLOPs: fused FLOPs/step vs k x solo FLOPs/step — < 1.0
+    because the frozen base never materializes dense weight gradients
+    (the merged solo path pays the full dW as the merge chain's backward
+    intermediate: ~6N vs ~4N per token)."""
+    from building_llm_from_scratch_tpu.configs import get_config
+    from building_llm_from_scratch_tpu.models import init_params
+    from building_llm_from_scratch_tpu.models.lora import init_lora_params
+    from building_llm_from_scratch_tpu.obs.compile import CompileWatcher
+    from building_llm_from_scratch_tpu.training import (
+        build_optimizer,
+        init_train_state,
+        make_train_step,
+    )
+    from building_llm_from_scratch_tpu.training.lora_fusion import (
+        init_fleet_state,
+        make_fused_train_step,
+    )
+
+    if _QUICK:
+        n_steps = min(n_steps, 6)
+    alpha = 2.0 * rank
+    cfg = get_config("GPT2", "124M", dtype="fp32", debug=True)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    T = cfg.context_length
+    batches = _fleet_batches(cfg, k, rows)
+    fleet_tokens = k * rows * T * n_steps
+
+    # -- arm A: k sequential solo finetunes (merged-lora step each) ------
+    solo_steady_s, solo_total_s, solo_flops = 0.0, 0.0, None
+    for j in range(k):
+        t_run = time.perf_counter()
+        opt = build_optimizer(total_steps=n_steps + 2)
+        lora = init_lora_params(cfg, params, jax.random.PRNGKey(10 + j),
+                                rank=rank)
+        # the donated step consumes the state's buffers — every solo run
+        # (and the fused arm after them) needs the base params alive
+        state = init_train_state(
+            lora, opt, jax.random.PRNGKey(j),
+            frozen=jax.tree_util.tree_map(lambda x: x.copy(), params))
+        step = CompileWatcher(
+            make_train_step(cfg, opt, lora_rank=rank, lora_alpha=alpha),
+            label="solo_step")
+        state, m = step(state, batches[j])      # compile + warm
+        float(jax.device_get(m["loss"]))
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            state, m = step(state, batches[j])
+        float(jax.device_get(m["loss"]))
+        solo_steady_s += time.perf_counter() - t0
+        solo_total_s += time.perf_counter() - t_run
+        if solo_flops is None:
+            solo_flops = step.hlo_flops_per_step
+
+    # -- arm B: one fused run, all k jobs per step -----------------------
+    t_run = time.perf_counter()
+    fstate = init_fleet_state(cfg, params, capacity=k,
+                              rng=jax.random.PRNGKey(0), rank=rank)
+    for j in range(k):
+        lora = init_lora_params(cfg, params, jax.random.PRNGKey(10 + j),
+                                rank=rank)
+        fstate["trainable"] = jax.tree_util.tree_map(
+            lambda pool, leaf, j=j: pool.at[j].set(leaf),
+            fstate["trainable"], lora)
+    from building_llm_from_scratch_tpu.training.lora_fusion import (
+        stack_fleet_batch,
+    )
+
+    fbatch = stack_fleet_batch(batches, capacity=k, scaling=alpha / rank,
+                               horizon=n_steps + 2)
+    fstep = CompileWatcher(make_fused_train_step(cfg, capacity=k),
+                           label="fused_step")
+    fstate, fm = fstep(fstate, fbatch)          # compile + warm
+    jax.device_get(fm["loss"])
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        fstate, fm = fstep(fstate, fbatch)
+    jax.device_get(fm["loss"])
+    fused_steady_s = time.perf_counter() - t0
+    fused_total_s = time.perf_counter() - t_run
+    fused_flops = fstep.hlo_flops_per_step
+
+    detail = {
+        "k": k, "rows_per_job": rows, "rank": rank, "n_steps": n_steps,
+        "solo_sequential": {
+            "fleet_tok_s": round(fleet_tokens / solo_total_s, 1),
+            "steady_tok_s": round(fleet_tokens / solo_steady_s, 1),
+            "fleet_wall_s": round(solo_total_s, 3),
+            "flops_per_step": solo_flops,
+        },
+        "fused": {
+            "fleet_tok_s": round(fleet_tokens / fused_total_s, 1),
+            "steady_tok_s": round(fleet_tokens / fused_steady_s, 1),
+            "fleet_wall_s": round(fused_total_s, 3),
+            "flops_per_step": fused_flops,
+            "recompiles": fstep.n_recompiles,
+        },
+        "agg_throughput_speedup": round(solo_total_s / fused_total_s, 2),
+        "steady_state_speedup": round(solo_steady_s / fused_steady_s, 2),
+    }
+    if solo_flops and fused_flops:
+        # fused step carries k jobs' tokens; k solo steps carry the same —
+        # < 1.0 means the shared frozen base is cheaper fused than merged
+        detail["fused_flops_vs_k_solo_steps"] = round(
+            fused_flops / (k * solo_flops), 3)
+        detail["per_token_flops_ratio"] = round(
+            (fused_flops / (k * rows * T)) / (solo_flops / (rows * T)), 3)
+    print(json.dumps(detail), flush=True)
+    return _result("lora_fusion", f"fused multi-LoRA agg adapter-train "
+                   f"tokens/sec (fleet wall) GPT2-debug fp32 k{k} x "
+                   f"{rows}rows rank{rank}",
+                   fleet_tokens / fused_total_s, unit="tokens/sec",
+                   detail=detail)
+
+
 # ---------------------------------------------------------------------------
 # Micro-benches: the CI perf-gate workloads (scripts/perf_gate.py)
 # ---------------------------------------------------------------------------
@@ -949,6 +1100,47 @@ def bench_micro_serve():
                    detail=detail)
 
 
+def bench_micro_lora_fusion():
+    """Debug-size fused multi-LoRA train step (2 jobs x 2 rows, rank 4):
+    the gate workload for the fused-finetune tier. Its fingerprint pins
+    the fused step's HLO — a lost gather (adapters silently merged), a
+    dense base-weight gradient sneaking into the backward, or a
+    per-job-identity recompile all show up as FLOP/program diffs with
+    the program named."""
+    from building_llm_from_scratch_tpu.configs import get_config
+    from building_llm_from_scratch_tpu.models import init_params
+    from building_llm_from_scratch_tpu.obs.compile import CompileWatcher
+    from building_llm_from_scratch_tpu.training.lora_fusion import (
+        init_fleet_state,
+        make_fused_train_step,
+        stack_fleet_batch,
+    )
+
+    k, rows, rank = 2, 2, 4
+    cfg = get_config("GPT2", "124M", dtype="fp32", debug=True)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    T = cfg.context_length
+    batches = _fleet_batches(cfg, k, rows)
+    state = init_fleet_state(cfg, params, capacity=k, rank=rank,
+                             rng=jax.random.PRNGKey(0))
+    batch = stack_fleet_batch(batches, capacity=k, scaling=2.0, horizon=8)
+    step = CompileWatcher(make_fused_train_step(cfg, capacity=k),
+                          label="fused_step")
+    warmup, iters = _q_iters(1, 4)
+    for _ in range(max(1, warmup)):
+        state, m = step(state, batch)
+    jax.device_get(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, m = step(state, batch)
+    jax.device_get(m["loss"])
+    dt = time.perf_counter() - t0
+    return _result("micro_lora_fusion", "fused multi-LoRA tokens/sec "
+                   f"GPT2-debug fp32 k{k} x {rows}rows rank{rank} ctx16",
+                   k * rows * T * iters / dt, unit="tokens/sec",
+                   detail={"recompiles": step.n_recompiles})
+
+
 BENCHES = {
     "headline": bench_headline,
     "cfg1": bench_cfg1,
@@ -964,14 +1156,17 @@ BENCHES = {
     "serve_load": bench_serve_load,
     "serve_lora": bench_serve_lora,
     "serve_prefix": bench_serve_prefix,
+    "lora_fusion": bench_lora_fusion,
     "micro_train": bench_micro_train,
     "micro_accum": bench_micro_accum,
     "micro_serve": bench_micro_serve,
+    "micro_lora_fusion": bench_micro_lora_fusion,
 }
 
 #: Micro-benches excluded from ``all`` (they are gate workloads, not
 #: performance claims — their tok/s on a debug model means nothing).
-MICRO_BENCHES = ("micro_train", "micro_accum", "micro_serve")
+MICRO_BENCHES = ("micro_train", "micro_accum", "micro_serve",
+                 "micro_lora_fusion")
 
 
 def run_bench(name: str, repeats: int = 1, quick: bool = False

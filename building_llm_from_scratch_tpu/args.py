@@ -59,9 +59,10 @@ def perform_checks(args) -> None:
     if not args.warnings:
         warnings.filterwarnings("ignore")
 
-    # serve mode decodes, it never reads the training corpus — the data
-    # dir requirement only applies to the training modes
-    if args.mode != "serve" and not os.path.exists(args.data_dir):
+    # serve mode decodes and finetune_fleet reads per-job record files
+    # (--fleet_jobs) — only the classic train pipeline discovers its
+    # corpus from --data_dir
+    if args.mode == "train" and not os.path.exists(args.data_dir):
         raise FileNotFoundError(
             f"Data directory '{args.data_dir}' does not exist.")
 
@@ -140,6 +141,62 @@ def perform_checks(args) -> None:
         if stray:
             raise ValueError(
                 f"{', '.join(stray)} require --mode serve.")
+
+    if args.mode == "finetune_fleet":
+        from building_llm_from_scratch_tpu.serving.frontend import (
+            parse_adapter_specs,
+        )
+
+        if not args.fleet_jobs:
+            raise ValueError(
+                "--mode finetune_fleet needs --fleet_jobs "
+                "name=records.json[,name=records.json...].")
+        specs = parse_adapter_specs(args.fleet_jobs, flag="--fleet_jobs")
+        for name, path in specs.items():
+            if not os.path.isfile(path):
+                raise FileNotFoundError(
+                    f"--fleet_jobs '{name}': records file '{path}' does "
+                    "not exist.")
+        if args.fleet_rows_per_job < 1:
+            raise ValueError("--fleet_rows_per_job must be >= 1.")
+        if args.fleet_capacity < 0:
+            raise ValueError("--fleet_capacity must be >= 0 "
+                             "(0 = one slot per listed job).")
+        # capacity 0 resolves to one slot per listed job — the blow-up
+        # guard must cover that path too, not just an explicit value
+        effective_capacity = args.fleet_capacity or len(specs)
+        if effective_capacity > 64:
+            raise ValueError(
+                f"a fused batch of {effective_capacity} job slots "
+                "(--fleet_capacity, or one per --fleet_jobs entry when "
+                "unset) is almost certainly a mistake — it multiplies "
+                "the fused batch; cap --fleet_capacity at <= 64 and let "
+                "extra jobs queue for freed slots.")
+        if args.lora_rank < 1:
+            raise ValueError("--lora_rank must be >= 1.")
+        if args.finetune:
+            raise ValueError(
+                "--mode finetune_fleet IS instruction finetuning; drop "
+                "--finetune (job data comes from --fleet_jobs).")
+        if args.use_lora:
+            raise ValueError(
+                "--mode finetune_fleet manages its own stacked adapter "
+                "pool; drop --use_lora (--lora_rank/--lora_alpha still "
+                "apply).")
+        if args.save_adapter:
+            raise ValueError(
+                "--mode finetune_fleet exports one artifact per job into "
+                "--fleet_export_dir; --save_adapter is the solo-run "
+                "export.")
+    else:
+        stray_fleet = [f"--{name}" for name, default in (
+            ("fleet_jobs", None), ("fleet_rows_per_job", 4),
+            ("fleet_capacity", 0), ("fleet_export_dir", None),
+            ("fleet_style", "alpaca"),
+        ) if getattr(args, name) != default]
+        if stray_fleet:
+            raise ValueError(
+                f"{', '.join(stray_fleet)} require --mode finetune_fleet.")
 
     if args.num_params not in MODEL_PARAMS_MAPPING.get(args.model, []):
         raise ValueError(
@@ -307,13 +364,19 @@ def get_args(argv=None):
 
     # Run mode
     parser.add_argument("--mode", type=str, default="train",
-                        choices=["train", "serve"],
+                        choices=["train", "serve", "finetune_fleet"],
                         help="'train' (default): the pretrain/finetune "
                              "pipeline. 'serve': the continuous-batching "
                              "decode engine (serving/) — load or init the "
                              "model per the usual model flags, then serve "
                              "--serve_prompts JSONL and/or an HTTP "
-                             "endpoint on --serve_port.")
+                             "endpoint on --serve_port. 'finetune_fleet': "
+                             "fused multi-LoRA finetuning (training/"
+                             "lora_fusion.py) — k tenants' jobs from "
+                             "--fleet_jobs train through ONE base "
+                             "forward/backward, each exporting a "
+                             "--serve_adapters-loadable artifact the "
+                             "moment it finishes.")
 
     # Dataset and I/O paths
     parser.add_argument("--data_dir", type=str, default="data",
@@ -444,6 +507,35 @@ def get_args(argv=None):
                         help="Prefix-store byte budget (MiB of device "
                              "memory for cached prefix KV panes); least-"
                              "recently-used entries evict past it.")
+
+    # Fused multi-LoRA finetuning (--mode finetune_fleet;
+    # training/lora_fusion.py)
+    parser.add_argument("--fleet_jobs", type=str, default=None,
+                        help="Fleet jobs as comma-separated name="
+                             "records.json pairs (Alpaca-format JSON per "
+                             "tenant). Each job trains its own LoRA "
+                             "adapter through the ONE fused step and "
+                             "exports <fleet_export_dir>/<name>.npz at "
+                             "ITS completion.")
+    parser.add_argument("--fleet_rows_per_job", type=int, default=4,
+                        help="Batch rows each job contributes per fused "
+                             "step (the fused batch is capacity x this).")
+    parser.add_argument("--fleet_capacity", type=int, default=0,
+                        help="Static job slots in the fused step (jobs "
+                             "beyond it queue and hot-join as slots "
+                             "free, with zero recompiles). 0 = one slot "
+                             "per listed job.")
+    parser.add_argument("--fleet_export_dir", type=str, default=None,
+                        help="Directory for per-job adapter artifacts "
+                             "(default <output_dir>/adapters).")
+    parser.add_argument("--fleet_style", type=str, default="alpaca",
+                        choices=["alpaca", "plain"],
+                        help="Job prompt template: 'alpaca' (the "
+                             "reference instruction template) or 'plain' "
+                             "(bare instruction+output — for tiny-"
+                             "context --debug runs where the template "
+                             "alone would overflow the context and zero "
+                             "every loss weight).")
 
     # Training configuration
     parser.add_argument("--n_epochs", type=int, default=2,

@@ -108,6 +108,18 @@ def main(args):
 
         return run_serve(args, comps, metric_logger)
 
+    # finetune_fleet mode: fused multi-LoRA training — k tenants' jobs
+    # through ONE base forward/backward, per-job artifact export at each
+    # job's own completion (training/lora_fusion.py)
+    if getattr(args, "mode", "train") == "finetune_fleet":
+        from building_llm_from_scratch_tpu.training.lora_fusion import (
+            run_finetune_fleet,
+        )
+
+        if is_coordinator():
+            os.makedirs(args.output_dir, exist_ok=True)
+        return run_finetune_fleet(args, comps, metric_logger)
+
     # constructed here, STARTED just before training inside the
     # try/finally below: starting now would leak the watcher thread if
     # loader/trainer setup raises, and start() is what arms the

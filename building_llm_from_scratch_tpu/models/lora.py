@@ -129,7 +129,12 @@ def apply_lora(x: jnp.ndarray, w: jnp.ndarray, node: Optional[Params],
 
     ``node``: ``{"A", "B"}``, either unbatched (``(in, r)``/``(r, out)``
     — one adapter for the whole batch) or per-row batched
-    (``(B, in, r)``/``(B, r, out)`` — the engine's BGMV gather output).
+    (``(B, in, r)``/``(B, r, out)`` — the BGMV gather output, shared by
+    the serving engine's slot paths AND the fused multi-LoRA TRAINING
+    forward, ``forward(..., adapter=)`` / training/lora_fusion.py: the
+    gather's transpose scatter-adds each row's gradient into its own
+    pool row, which is what makes k jobs trainable through one base
+    backward).
     ``None`` returns exactly ``x @ w`` (bit-identical base path).
     ``scaling``: alpha/rank — a scalar, or ``(B,)`` per-row scales
     (0 = zero delta, the id −1 base-model row). A node carrying a

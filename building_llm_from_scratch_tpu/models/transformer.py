@@ -485,7 +485,8 @@ def forward_hidden(params: Params, cfg: ModelConfig, tokens: jnp.ndarray, *,
                    deterministic: bool = True,
                    sp_mesh=None, sp_inside=None,
                    lora: Optional[Params] = None,
-                   lora_scaling=1.0) -> jnp.ndarray:
+                   lora_scaling=1.0,
+                   adapter: Optional[Params] = None) -> jnp.ndarray:
     """Forward up to (and including) the final norm — the (B, T, D) hidden
     states BEFORE the output head. The training loss path consumes this
     directly via ops/softmax_xent.py so (B, T, V) fp32 logits never
@@ -495,7 +496,19 @@ def forward_hidden(params: Params, cfg: ModelConfig, tokens: jnp.ndarray, *,
     ``lora``: optional unmerged adapter tree (models/lora.py layout),
     applied at every adapted projection via ``apply_lora`` — the
     merge-free path serving shares. Not composable with tp/sp sharding
-    (adapters multiply against the full weights)."""
+    (adapters multiply against the full weights).
+
+    ``adapter``: optional per-ROW adapter pool ``{"pool": stacked
+    (n, ...) lora tree, "scaling": (n,), "ids": (B,)}`` — the serving
+    slot paths' BGMV gather applied to the full-sequence TRAINING
+    forward: each batch row multiplies against its own gathered A/B
+    (id −1 = zeroed scale = exact base path), so k finetune jobs'
+    rows share ONE base forward/backward (training/lora_fusion.py).
+    Job identity is data: changing ids never recompiles. Mutually
+    exclusive with ``lora``; same tp/sp caveat."""
+    if lora is not None and adapter is not None:
+        raise ValueError("forward_hidden: pass lora= (one shared adapter) "
+                         "or adapter= (per-row pool), not both")
     L = cfg.n_layers
     rope = _rope_tables(cfg)
     if rng is None:
@@ -518,13 +531,31 @@ def forward_hidden(params: Params, cfg: ModelConfig, tokens: jnp.ndarray, *,
 
     x = _embed(cfg, params, tokens, positions, emb_rng, deterministic)
 
+    if adapter is not None:
+        # BGMV gather ONCE for the whole batch (the serving-path math,
+        # _adapter_rows) — blocks subtree only; the head gathers
+        # separately in forward() (gathering the whole pool here would
+        # eagerly materialize discarded (B, r, V) head rows on
+        # non-jitted calls). Gathered leaves are (B, L, in, r) —
+        # re-lead with the layer axis so the scan slices each layer's
+        # (B, in, r) per-row matrices
+        rows, row_s = _adapter_rows(adapter["pool"]["blocks"],
+                                    adapter["scaling"], adapter["ids"])
+        row_blocks = jax.tree_util.tree_map(
+            lambda a: jnp.moveaxis(a, 1, 0), rows)
+    else:
+        row_blocks = row_s = None
+
     def body(carry, layer):
-        if lora is None:
-            p, lrng = layer
-            adp = None
-        else:
+        if lora is not None:
             p, lrng, lb = layer
             adp = _block_adp(lb, lora_scaling)
+        elif adapter is not None:
+            p, lrng, lb = layer
+            adp = _block_adp(lb, row_s)
+        else:
+            p, lrng = layer
+            adp = None
         r = None if deterministic else lrng
         y, _ = _block(cfg, p, carry, rope, positions, None, None, r,
                       deterministic, sp_mesh=sp_mesh, sp_inside=sp_inside,
@@ -556,8 +587,12 @@ def forward_hidden(params: Params, cfg: ModelConfig, tokens: jnp.ndarray, *,
                 "q", "k", "v", "attn_raw_out", "attn_lse", "attn_out",
                 "resid_mid", "up_out", "gate_out"))
 
-    xs = ((params["blocks"], layer_rngs) if lora is None
-          else (params["blocks"], layer_rngs, lora["blocks"]))
+    if lora is not None:
+        xs = (params["blocks"], layer_rngs, lora["blocks"])
+    elif adapter is not None:
+        xs = (params["blocks"], layer_rngs, row_blocks)
+    else:
+        xs = (params["blocks"], layer_rngs)
     x, _ = jax.lax.scan(body, x, xs, unroll=_train_scan_unroll(cfg))
     return _norm(cfg, params["final_norm"], x)
 
@@ -566,7 +601,8 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray, *,
             rng: Optional[jax.Array] = None,
             deterministic: bool = True,
             sp_mesh=None, sp_inside=None,
-            lora: Optional[Params] = None, lora_scaling=1.0) -> jnp.ndarray:
+            lora: Optional[Params] = None, lora_scaling=1.0,
+            adapter: Optional[Params] = None) -> jnp.ndarray:
     """Training/eval forward over full sequences.
 
     tokens: (B, T) int32.  Returns fp32 logits (B, T, V).
@@ -576,11 +612,21 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray, *,
     long-context training. Everything else (embeddings, norms, MLPs, loss)
     is token-local, so GSPMD shards it over the seq axis from the batch
     sharding alone; only attention needs the explicit ring.
+
+    ``adapter``: per-row adapter pool (see ``forward_hidden``) — the head
+    delta rides per-row gathered head matrices, exactly like
+    ``decode_slots``.
     """
     x = forward_hidden(params, cfg, tokens, rng=rng,
                        deterministic=deterministic, sp_mesh=sp_mesh,
                        sp_inside=sp_inside, lora=lora,
-                       lora_scaling=lora_scaling)
+                       lora_scaling=lora_scaling, adapter=adapter)
+    if adapter is not None:
+        head_rows, head_s = _adapter_rows(
+            {"head": adapter["pool"]["head"]}, adapter["scaling"],
+            adapter["ids"])
+        return _head_logits(x, params["head"]["weight"],
+                            head_rows["head"]["weight"], head_s)
     return _head_logits(x, params["head"]["weight"],
                         lora["head"]["weight"] if lora is not None else None,
                         lora_scaling)

@@ -117,6 +117,14 @@ def _group_nonfinite(tree: Dict[str, Any]) -> jnp.ndarray:
     return jnp.concatenate(parts)
 
 
+def group_norms(tree: Dict[str, Any]) -> jnp.ndarray:
+    """(n_groups,) fp32 L2 norms per group, in ``group_names`` order —
+    the public pre-clip view of ``_group_sumsq`` (the fused multi-LoRA
+    step clips each job's gradient by ITS group norm, so it needs the
+    norms before it can build the updates ``group_health`` wants)."""
+    return jnp.sqrt(_group_sumsq(tree))
+
+
 def first_nonfinite_group(tree: Dict[str, Any]) -> jnp.ndarray:
     """Index (int32 scalar) of the first group containing a non-finite
     value, or -1 when all groups are finite. Index into ``group_names``."""

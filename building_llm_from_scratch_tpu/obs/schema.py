@@ -35,7 +35,9 @@ from typing import Any, Dict, FrozenSet, List
 
 #: Bump when a row type or a load-bearing field changes meaning. The
 #: ``header`` row carries it; consumers key parsing decisions on it.
-SCHEMA_VERSION = 5          # v5: + bench_result event (perf observatory)
+SCHEMA_VERSION = 6          # v6: + finetune_job_*/finetune_fleet events
+                            # (fused multi-LoRA training), adapter_save
+                            # grew job_id
 
 #: JSONL row discriminators (the ``type`` field).
 ROW_TYPES = ("header", "metrics", "health", "event", "span")
@@ -185,9 +187,33 @@ _EVENT_LIST: List[EventSpec] = [
           doc="one request failed in isolation (or engine death/restart)"),
     # -- serving: multi-tenant LoRA adapters ------------------------------
     _spec("adapter_save", required=("path",),
-          optional=("rank", "alpha", "n_params", "fingerprint"),
+          optional=("rank", "alpha", "n_params", "fingerprint", "job_id"),
           doc="finetuning exported a LoRA adapter artifact "
-              "(--save_adapter)"),
+              "(--save_adapter, or a fused-fleet job finishing — then "
+              "job_id names the tenant whose deployment just unblocked)"),
+    # -- fused multi-LoRA training (training/lora_fusion.py) ---------------
+    _spec("finetune_job_start", required=("job_id",),
+          optional=("slot", "total_steps", "n_records", "n_epochs",
+                    "rows_per_step"),
+          doc="a fleet job hot-joined a free slot (identity is data: "
+              "joining never recompiles the fused step)"),
+    _spec("finetune_job_done", required=("job_id",),
+          optional=("steps", "final_loss", "artifact", "deployed",
+                    "seconds"),
+          doc="a fleet job completed: its adapter exported at JOB "
+              "finish (slow co-tenants don't block it) and optionally "
+              "hot-loaded into the deploy registry"),
+    _spec("finetune_job_failed", required=("job_id", "reason"),
+          optional=("slot", "steps", "loss", "grad_norm"),
+          doc="a fleet job retired in isolation (non-finite training "
+              "signal; its in-graph updates were already skipped, "
+              "co-trained jobs bit-identical)"),
+    _spec("finetune_fleet", required=("phase",),
+          optional=("n_jobs", "capacity", "rank", "alpha", "rows_per_job",
+                    "jobs_done", "jobs_failed", "seconds",
+                    "flops_per_token_base", "flops_per_token_adapter"),
+          doc="fleet run bracketing (phase: start|end) + the analytic "
+              "base-vs-adapter FLOPs split the renderer reports"),
     _spec("adapter_load", required=("name",),
           optional=("path", "row", "rank", "alpha", "seconds",
                     "n_loaded", "capacity"),

@@ -314,6 +314,19 @@ class AdapterRegistry:
                     name, row, rank, n_loaded, self.capacity)
         return row
 
+    def replace(self, name: str, path: str) -> int:
+        """Evict-if-present then load — the continuous train→deploy hop
+        (training/lora_fusion.py): a fleet job that finishes REDEPLOYS
+        its tenant's adapter under the same name. The evicted install's
+        row stays untouched until in-flight requests retire (the in-use
+        probe), the reload gets a fresh ``load_tag`` so derived state
+        (cached prefix panes) auto-invalidates, and requests queued
+        between evict and load fail alone with ``adapter_not_loaded`` —
+        exactly the evicted-while-queued semantics already tested."""
+        if self._by_name.get(name) is not None:
+            self.evict(name)
+        return self.load(name, path)
+
     def evict(self, name: str) -> int:
         """Unload ``name``: new submits for it are rejected immediately;
         the pool row's weights stay in place until every active slot

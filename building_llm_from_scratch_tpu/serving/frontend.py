@@ -53,28 +53,27 @@ from building_llm_from_scratch_tpu.utils.logging import setup_logger
 logger = setup_logger(__name__)
 
 
-def parse_adapter_specs(spec: str) -> dict:
-    """``--serve_adapters`` value -> {name: artifact_path}. Format:
-    comma-separated ``name=path`` pairs; names must be unique."""
+def parse_adapter_specs(spec: str, flag: str = "--serve_adapters") -> dict:
+    """``name=path[,name=path...]`` -> {name: path}. Names must be
+    unique. Shared by ``--serve_adapters`` (adapter artifacts) and the
+    fused-finetune fleet's ``--fleet_jobs`` (per-tenant record files) —
+    ``flag`` only labels the error messages."""
     out: dict = {}
     for part in spec.split(","):
         part = part.strip()
         if not part:
             continue
         if "=" not in part:
-            raise ValueError(
-                f"--serve_adapters entry '{part}' is not name=path")
+            raise ValueError(f"{flag} entry '{part}' is not name=path")
         name, path = part.split("=", 1)
         name, path = name.strip(), path.strip()
         if not name or not path:
-            raise ValueError(
-                f"--serve_adapters entry '{part}' is not name=path")
+            raise ValueError(f"{flag} entry '{part}' is not name=path")
         if name in out:
-            raise ValueError(f"--serve_adapters names adapter '{name}' "
-                             "twice")
+            raise ValueError(f"{flag} names '{name}' twice")
         out[name] = path
     if not out:
-        raise ValueError("--serve_adapters is empty")
+        raise ValueError(f"{flag} is empty")
     return out
 
 

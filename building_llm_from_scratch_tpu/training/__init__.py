@@ -38,9 +38,17 @@ from building_llm_from_scratch_tpu.training.resilience import (
     resolve_resume,
     validate_checkpoint,
 )
+from building_llm_from_scratch_tpu.training.lora_fusion import (
+    FinetuneJob,
+    FusedLoRATrainer,
+    make_fused_train_step,
+)
 from building_llm_from_scratch_tpu.training.trainer import Trainer
 
 __all__ = [
+    "FinetuneJob",
+    "FusedLoRATrainer",
+    "make_fused_train_step",
     "build_optimizer",
     "warmup_cosine_schedule",
     "POLICIES",

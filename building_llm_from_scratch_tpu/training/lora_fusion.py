@@ -1,0 +1,928 @@
+"""Fused multi-LoRA finetuning: k tenants' adapters through ONE base
+forward/backward, with continuous train→deploy.
+
+The serving tier (PR 9) multiplexes thousands of adapters on one resident
+base model, but every adapter was still TRAINED in its own solo run — k
+tenants cost k× the dominant FLOPs (and k× the compiles, k× the dispatch).
+This module fuses the fleet (LoRAFusion / FLoRA, PAPERS.md): k jobs'
+adapters live in a stacked ``(n_jobs, ...)`` device-resident pool — the
+same stacked layout as ``serving.adapters.AdapterRegistry`` — their Alpaca
+batches stack along a jobs axis with per-row ``job_ids`` as traced data,
+and ONE jitted train step runs them all:
+
+  - the frozen base forward/backward is computed once over the stacked
+    batch; per-job LoRA deltas ride the existing BGMV gather + einsum
+    (``models/lora.apply_lora`` via ``forward(..., adapter=)``) — and
+    because the base is frozen, the backward never materializes dense
+    weight gradients (the merged solo path pays them as the ``merge_lora``
+    chain's intermediate), so fused FLOPs/token ~ 4·N instead of 6·N;
+  - gradients flow ONLY to the stacked adapter leaves (the gather's
+    transpose scatter-adds each row's grads into its own pool row — jobs
+    are mathematically isolated because the base is frozen and the
+    per-job losses are additive);
+  - optimizer state is per-job: stacked AdamW moments, per-job step
+    counts, per-job warmup+cosine LR over each job's OWN horizon (a
+    traced ``(J,)`` vector — joining a short job next to a long one never
+    recompiles), per-job global-norm clipping (one job's spike cannot cap
+    its co-tenants), per-job loss masking (weighted-CE mean per job,
+    exactly the solo trainer's semantics);
+  - per-job health rides the existing ``obs/health.py`` group machinery:
+    the stacked trees ARE a stacked-leading-axis group tree, so
+    ``group_health`` returns (J,) grad/param/update norms and first-
+    non-finite-JOB localization with no new code;
+  - a job whose gradients go non-finite is skipped in-graph the same step
+    (params/moments/count kept) and retired by the host at the next
+    metrics flush — co-trained jobs' trajectories are bit-identical to a
+    run where the sick job never misbehaved (test-pinned, mirroring the
+    serving fault-isolation tests).
+
+Job identity is DATA and job count is static capacity: jobs hot-join free
+slots and finish early without recompiling — the one-compiled-program
+invariant, enforced by a frozen ``obs/compile.CompileWatcher`` (label
+``fused_step``) and the GL02x graft-lint rules. A finished job exports
+through the existing ``models/lora.save_adapter`` artifact path (atomic
+tmp+rename, base-config fingerprint) the moment IT finishes — slow jobs
+never block fast tenants' deployments — and can hot-load straight into a
+live ``AdapterRegistry`` (``deploy=``), closing the loop: tenant uploads
+data, gets a served adapter, all on one resident base model.
+
+CLI: ``--mode finetune_fleet --fleet_jobs a=a.json,b=b.json`` (main.py
+dispatches to ``run_finetune_fleet``). Proof rides the perf observatory:
+``bench.py lora_fusion`` A/Bs k sequential solo finetunes against one
+fused run; ``micro_lora_fusion`` structurally gates the fused step's HLO
+in CI (PERF_BASELINE.json).
+
+Known cost (documented, ROADMAP follow-up): the per-row gather
+materializes each job's A/B once per ROW (``rows_per_job``-fold
+duplication — rows of one job share an adapter). Fine at current slot
+counts; large capacities want slot-aligned application over a
+``(J, R, T)`` reshape, which applies each adapter once.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import os
+import re
+import time
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from building_llm_from_scratch_tpu.configs import ModelConfig
+from building_llm_from_scratch_tpu.data.instruct import (
+    InstructionDataset,
+    collate_batch,
+)
+from building_llm_from_scratch_tpu.models.lora import (
+    adapter_fingerprint,
+    count_lora_params,
+    init_lora_params,
+    save_adapter,
+)
+from building_llm_from_scratch_tpu.models.transformer import forward
+from building_llm_from_scratch_tpu.obs.compile import CompileWatcher
+from building_llm_from_scratch_tpu.obs.health import group_health, group_norms
+from building_llm_from_scratch_tpu.obs.metrics import get_metrics
+from building_llm_from_scratch_tpu.utils.logging import setup_logger
+
+logger = setup_logger(__name__)
+
+Params = Dict[str, Any]
+
+#: free slots are named "slot<N>" in per-job telemetry (slot_names);
+#: job names matching it are refused at add_job so a tenant can never be
+#: mistaken for an empty slot in health rows or the renderer
+_FREE_SLOT_RE = re.compile(r"slot\d+")
+
+#: metrics arrays the host defers-then-fetches per step (obs discipline:
+#: DMAs posted at append time, converted only at flush cadence). Only
+#: what _flush actually reads — update_norm rides the health bundle;
+#: weights feeds the per-job supervised-token ledger (a job that never
+#: saw a supervised token must not export).
+_FETCHED_METRICS = ("loss", "grad_norm", "lr", "finite", "weights")
+
+
+def stack_fleet_batch(job_batches, *, capacity: int, scaling: float,
+                      horizon=1) -> Dict[str, np.ndarray]:
+    """Stack per-slot ``{"inputs","targets","weights"}`` row-blocks into
+    ONE fused batch: slot j's rows occupy ``[j*R, (j+1)*R)`` with
+    ``job_ids = j``; a ``None`` entry (a free slot) and slots past
+    ``len(job_batches)`` are inactive padding (ids −1, zero rows).
+    ``horizon`` is an int or a per-slot sequence. THE one fused-batch
+    constructor — the engine's ``_build_batch``, the benches and the
+    tests all delegate here, so the step's batch contract cannot drift
+    between them."""
+    entries = list(job_batches)
+    if len(entries) > capacity:
+        raise ValueError(f"{len(entries)} job batches exceed "
+                         f"capacity {capacity}")
+    first = next((e for e in entries if e is not None), None)
+    if first is None:
+        raise ValueError("stack_fleet_batch needs at least one job batch")
+    R, T = first["inputs"].shape
+    J = int(capacity)
+    horizons = np.maximum(
+        1, np.broadcast_to(np.asarray(horizon, np.int32), (J,)))
+    batch = {
+        "inputs": np.zeros((J * R, T), np.int32),
+        "targets": np.zeros((J * R, T), np.int32),
+        "weights": np.zeros((J * R, T), np.float32),
+        "job_ids": np.full((J * R,), -1, np.int32),
+        "active": np.zeros((J,), bool),
+        "scaling": np.full((J,), scaling, np.float32),
+        "horizon": horizons.astype(np.int32),
+    }
+    for j, jb in enumerate(entries):
+        if jb is None:
+            continue
+        sl = slice(j * R, (j + 1) * R)
+        batch["inputs"][sl] = jb["inputs"]
+        batch["targets"][sl] = jb["targets"]
+        batch["weights"][sl] = jb["weights"]
+        batch["job_ids"][sl] = j
+        batch["active"][j] = True
+    return batch
+
+
+def fleet_lr_schedule(counts: jnp.ndarray, horizons: jnp.ndarray, *,
+                      peak_lr: float, initial_lr: float, min_lr: float,
+                      warmup_steps: int) -> jnp.ndarray:
+    """Vectorized warmup+cosine LR: ``training/optim.warmup_cosine_
+    schedule`` elementwise over per-job step counts with per-job horizons
+    as TRACED data — k jobs with k different dataset sizes share one
+    compiled step. ``counts`` is each job's pre-increment optimizer count
+    (optax ``scale_by_schedule`` semantics: the schedule sees the count
+    before the step increments it)."""
+    warmup = max(1, int(warmup_steps))
+    step = counts.astype(jnp.float32) + 1.0        # pre-incremented step
+    warm = initial_lr + step * (peak_lr - initial_lr) / warmup
+    denom = jnp.maximum(1.0, horizons.astype(jnp.float32) - warmup)
+    progress = (step - warmup) / denom
+    cosine = min_lr + (peak_lr - min_lr) * 0.5 * (
+        1.0 + jnp.cos(jnp.pi * progress))
+    return jnp.where(step < warmup, warm, cosine)
+
+
+def init_fleet_state(cfg: ModelConfig, base_params: Params, *,
+                     capacity: int, rank: int, rng: jax.Array) -> Params:
+    """The fused step's donated state: a zeroed stacked ``(J, ...)``
+    adapter pool (rows are initialized per-job at admission), stacked
+    AdamW moments, per-job int32 step counts, the frozen base, a fused
+    step counter and the dropout RNG. Plain pytree — donates, shards and
+    checkpoints like any train state."""
+    template = init_lora_params(cfg, base_params, jax.random.PRNGKey(0),
+                                rank=rank)
+    pool = jax.tree_util.tree_map(
+        lambda a: jnp.zeros((capacity,) + a.shape, a.dtype), template)
+    zeros_like_pool = lambda: jax.tree_util.tree_map(jnp.zeros_like, pool)
+    # the first donated step consumes these buffers — base_params may be
+    # the caller's live tree (Trainer learned this in round 2)
+    frozen = jax.tree_util.tree_map(
+        lambda x: x.copy() if isinstance(x, jax.Array) else jnp.asarray(x),
+        base_params)
+    return {
+        "trainable": pool,
+        "frozen": frozen,
+        "mu": zeros_like_pool(),
+        "nu": zeros_like_pool(),
+        "counts": jnp.zeros((capacity,), jnp.int32),
+        "step": jnp.zeros((), jnp.int32),
+        "rng": rng,
+    }
+
+
+def make_fused_train_step(cfg: ModelConfig, *, capacity: int,
+                          peak_lr: float = 5e-4, initial_lr: float = 1e-5,
+                          min_lr: float = 1e-6, warmup_steps: int = 10,
+                          weight_decay: float = 0.1,
+                          grad_clip_norm: float = 1.0,
+                          b1: float = 0.9, b2: float = 0.999,
+                          eps: float = 1e-8,
+                          jit: bool = True) -> Callable:
+    """Build ``fused_step(state, batch) -> (state, metrics)``.
+
+    ``batch``: ``inputs``/``targets``/``weights`` (B, T) stacked across
+    jobs, ``job_ids`` (B,) int32 (−1 = padding row: gather clamps, scale
+    zeroes, loss weight zero), ``active`` (J,) bool, ``scaling`` (J,)
+    fp32 (alpha/rank per slot), ``horizon`` (J,) int32 (per-job schedule
+    total). All per-job identity is traced data; the ONE compiled program
+    serves every join/finish/retire.
+
+    The optimizer reproduces the solo chain
+    ``clip_by_global_norm -> scale_by_adam -> add_decayed_weights ->
+    scale_by_learning_rate`` per job: clipping scopes to the job's own
+    adapter tree (exactly the solo trainer's global norm), bias
+    correction uses per-job counts, and a job whose loss or gradient
+    norm is non-finite keeps its params/moments/count untouched this
+    step (the in-graph half of fault isolation; the host retires it at
+    the next flush)."""
+    J = int(capacity)
+
+    def bcast(vec: jnp.ndarray, leaf: jnp.ndarray) -> jnp.ndarray:
+        return vec.reshape((J,) + (1,) * (leaf.ndim - 1))
+
+    def fused_step(state: Params, batch: Dict[str, jnp.ndarray]):
+        step_rng = jax.random.fold_in(state["rng"], state["step"])
+        ids = batch["job_ids"].astype(jnp.int32)
+        active = batch["active"]
+        # belt + suspenders: an inactive slot's scaling is zeroed even if
+        # a stale row id slipped into the batch
+        scaling = jnp.where(active, batch["scaling"].astype(jnp.float32),
+                            0.0)
+
+        def loss_fn(trainable):
+            adapter = {"pool": trainable, "scaling": scaling, "ids": ids}
+            logits = forward(state["frozen"], cfg, batch["inputs"],
+                             rng=step_rng,
+                             deterministic=(cfg.drop_rate <= 0.0),
+                             adapter=adapter)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            ll = jnp.take_along_axis(
+                logp, batch["targets"][..., None].astype(jnp.int32),
+                axis=-1)[..., 0]
+            w = batch["weights"].astype(jnp.float32)
+            # where-masked: a NaN logit in one job's rows must never ride
+            # a 0-weight product into another job's sum
+            row_nll = -jnp.sum(jnp.where(w > 0, ll * w, 0.0), axis=-1)
+            row_w = jnp.sum(w, axis=-1)
+            m = (ids[:, None] == jnp.arange(J)[None, :]) & (
+                ids >= 0)[:, None]
+            nll_j = jnp.sum(jnp.where(m, row_nll[:, None], 0.0), axis=0)
+            w_j = jnp.sum(jnp.where(m, row_w[:, None], 0.0), axis=0)
+            # per-job weighted mean — the solo trainer's loss, one per job
+            loss_j = nll_j / jnp.maximum(w_j, 1.0)
+            # summing per-job means gives each job's adapter EXACTLY the
+            # gradient of its own loss (the base is frozen; cross terms
+            # are structurally zero)
+            total = jnp.sum(jnp.where(w_j > 0, loss_j, 0.0))
+            return total, (loss_j, w_j)
+
+        (_, (loss_j, w_j)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state["trainable"])
+
+        # per-job pre-clip gradient norms via the health group machinery:
+        # a stacked-leading-axis tree IS a group tree (obs/health.py)
+        gnorm_j = group_norms({"blocks": grads})
+        finite_j = jnp.isfinite(loss_j) & jnp.isfinite(gnorm_j)
+        ok_j = active & finite_j
+
+        clip = float(grad_clip_norm)
+        cscale = jnp.where(gnorm_j < clip, 1.0,
+                           clip / jnp.maximum(gnorm_j, 1e-38))
+        gc = jax.tree_util.tree_map(
+            lambda g: g * bcast(cscale, g).astype(g.dtype), grads)
+
+        trainable, mu, nu = state["trainable"], state["mu"], state["nu"]
+        cc = (state["counts"] + 1).astype(jnp.float32)
+        bc1 = 1.0 - jnp.power(b1, cc)
+        bc2 = 1.0 - jnp.power(b2, cc)
+        mu_new = jax.tree_util.tree_map(
+            lambda m, g: b1 * m + (1.0 - b1) * g, mu, gc)
+        nu_new = jax.tree_util.tree_map(
+            lambda v, g: b2 * v + (1.0 - b2) * (g * g), nu, gc)
+        upd = jax.tree_util.tree_map(
+            lambda m, v, p: (m / bcast(bc1, m))
+            / (jnp.sqrt(v / bcast(bc2, v)) + eps)
+            + weight_decay * p,
+            mu_new, nu_new, trainable)
+        lr_j = fleet_lr_schedule(state["counts"], batch["horizon"],
+                                 peak_lr=peak_lr, initial_lr=initial_lr,
+                                 min_lr=min_lr, warmup_steps=warmup_steps)
+        stepped = jax.tree_util.tree_map(
+            lambda p, u: p - (bcast(lr_j, u) * u).astype(p.dtype),
+            trainable, upd)
+
+        def select(new, old):
+            return jax.tree_util.tree_map(
+                lambda n, o: jnp.where(bcast(ok_j, n), n, o), new, old)
+
+        new_trainable = select(stepped, trainable)
+        applied = jax.tree_util.tree_map(
+            lambda n, o: n - o, new_trainable, trainable)
+        health = group_health({"blocks": grads},
+                              {"blocks": new_trainable},
+                              {"blocks": applied})
+        new_state = {
+            "trainable": new_trainable,
+            "frozen": state["frozen"],
+            "mu": select(mu_new, mu),
+            "nu": select(nu_new, nu),
+            "counts": state["counts"] + ok_j.astype(jnp.int32),
+            "step": state["step"] + 1,
+            "rng": state["rng"],
+        }
+        metrics = {
+            "loss": loss_j,                        # (J,) per-job means
+            "grad_norm": gnorm_j,                  # (J,) pre-clip
+            "update_norm": health["update_norm"],  # (J,) post-clip applied
+            "lr": lr_j,
+            "finite": finite_j,
+            "ok": ok_j,
+            "weights": w_j,                        # supervised tokens/job
+            "health": health,
+        }
+        return new_state, metrics
+
+    if jit:
+        return jax.jit(fused_step, donate_argnums=(0,))
+    return fused_step
+
+
+# ---------------------------------------------------------------------------
+# Jobs
+# ---------------------------------------------------------------------------
+
+def _plain_items(records: Sequence[Dict[str, str]], tokenizer):
+    """Template-free (instruction, output) encoding for tiny-context
+    runs: the Alpaca template alone exceeds a --debug model's 16-token
+    context, which would zero every loss weight. Same (instr_len, ids)
+    item shape as ``InstructionDataset``."""
+    items = []
+    for entry in records:
+        prompt = entry["instruction"] + (
+            "\n" + entry["input"] if entry.get("input") else "")
+        ids = tokenizer.encode(prompt + " " + entry["output"])
+        items.append((len(tokenizer.encode(prompt)), ids))
+    return items
+
+
+@dataclasses.dataclass
+class FinetuneJob:
+    """One tenant's finetune job: a deterministic per-epoch batch factory
+    plus the host-side run state the fleet engine tracks.
+
+    ``make_batches(epoch)`` yields ``(inputs, targets, weights)`` arrays
+    of exactly ``rows_per_step`` rows; ``total_steps`` is the job's
+    schedule horizon (its cosine decays over its OWN length)."""
+
+    name: str
+    make_batches: Callable[[int], Iterator]
+    steps_per_epoch: int
+    n_epochs: int
+    export_path: Optional[str] = None
+    n_records: int = 0
+    init: Optional[Params] = None      # adapter init override (tests)
+
+    # runtime (engine-owned)
+    slot: Optional[int] = None
+    steps_done: int = 0
+    status: str = "pending"            # pending|running|done|failed
+    supervised_tokens: float = 0.0     # Σ loss weights actually trained on
+    final_loss: Optional[float] = None
+    artifact: Optional[str] = None
+    error: Optional[str] = None
+    t_admitted: Optional[float] = None
+    _epoch: int = dataclasses.field(default=0, repr=False)
+    _iter: Optional[Iterator] = dataclasses.field(default=None, repr=False)
+
+    @property
+    def total_steps(self) -> int:
+        return self.steps_per_epoch * self.n_epochs
+
+    def next_rows(self):
+        """The job's next ``rows_per_step`` collated rows, cycling epochs
+        (each epoch reshuffles deterministically in (seed, epoch)).
+        Bounded: a fresh epoch iterator that yields NOTHING raises
+        instead of busy-looping the whole fleet (``from_records`` guards
+        this, but ``make_batches`` is caller-supplied)."""
+        for _ in range(2):
+            if self._iter is None:
+                self._iter = iter(self.make_batches(self._epoch))
+            try:
+                return next(self._iter)
+            except StopIteration:
+                self._epoch += 1
+                self._iter = None
+        raise ValueError(
+            f"job '{self.name}': make_batches(epoch={self._epoch - 1}) "
+            "yielded no batches")
+
+    @classmethod
+    def from_records(cls, name: str, records: Sequence[Dict[str, str]],
+                     tokenizer, *, max_length: int, rows_per_step: int,
+                     n_epochs: int, pad_token_id: int, seed: int = 123,
+                     style: str = "alpaca",
+                     export_path: Optional[str] = None) -> "FinetuneJob":
+        """Build a job from Alpaca-format records: encode ONCE, then
+        yield shuffled fixed-shape ``collate_batch`` batches per epoch
+        (the InstructLoader discipline, per-tenant)."""
+        if style == "alpaca":
+            ds = InstructionDataset(records, tokenizer)
+            items = [ds[i] for i in range(len(ds))]
+        elif style == "plain":
+            items = _plain_items(records, tokenizer)
+        else:
+            raise ValueError(f"unknown job style '{style}' "
+                             "(alpaca|plain)")
+        if len(items) < rows_per_step:
+            raise ValueError(
+                f"job '{name}': {len(items)} records cannot fill one "
+                f"{rows_per_step}-row step")
+        steps_per_epoch = len(items) // rows_per_step
+
+        def make_batches(epoch: int):
+            order = np.arange(len(items))
+            rng = np.random.default_rng(seed + epoch)
+            rng.shuffle(order)
+            for b in range(steps_per_epoch):
+                sl = order[b * rows_per_step:(b + 1) * rows_per_step]
+                yield collate_batch([items[i] for i in sl],
+                                    pad_token_id=pad_token_id,
+                                    allowed_max_length=max_length)
+
+        return cls(name=name, make_batches=make_batches,
+                   steps_per_epoch=steps_per_epoch, n_epochs=n_epochs,
+                   export_path=export_path, n_records=len(records))
+
+
+# ---------------------------------------------------------------------------
+# The fleet engine
+# ---------------------------------------------------------------------------
+
+def fleet_flops_split(cfg: ModelConfig, rank: int) -> Dict[str, float]:
+    """Analytic per-token FLOPs split the renderer's fused-finetune
+    section reports: the shared frozen-base share (4·N — forward + dx
+    backward, no dense dW) vs the per-job adapter share (A/B forward +
+    their three backward contractions)."""
+    D, F, hd = cfg.emb_dim, cfg.hidden_dim, cfg.head_dim
+    Hq, Hkv, T = cfg.n_heads, cfg.n_kv_groups, cfg.context_length
+    per_layer = (D * Hq * hd + 2 * D * Hkv * hd + Hq * hd * D
+                 + (3 if cfg.activation == "swiglu" else 2) * D * F)
+    n_matmul = cfg.n_layers * per_layer + D * cfg.vocab_size
+    attn = cfg.n_layers * 2 * 2 * (T / 2) * (Hq * hd) * 3
+    base = 4 * n_matmul + attn
+    proj_dims = [(D, Hq * hd), (D, Hkv * hd), (D, Hkv * hd), (Hq * hd, D),
+                 (D, F), (F, D)]
+    if cfg.activation == "swiglu":
+        proj_dims.append((D, F))
+    adapter_matmul = (cfg.n_layers * sum(i + o for i, o in proj_dims)
+                      + (D + cfg.vocab_size)) * rank
+    # fwd (2·) + backward dx/dA/dB (~3 more matmul pairs of the same size)
+    adapter = 2 * adapter_matmul * 4
+    return {"flops_per_token_base": float(base),
+            "flops_per_token_adapter": float(adapter)}
+
+
+class FusedLoRATrainer:
+    """Drives k LoRA finetune jobs through one fused train step on one
+    resident base model, with per-job export-on-finish and an optional
+    hot-load deploy hop into a live ``AdapterRegistry``.
+
+        fleet = FusedLoRATrainer(cfg, params, tokenizer=tok, capacity=4,
+                                 rank=8, alpha=16)
+        fleet.add_job(FinetuneJob.from_records("tenant-a", records, tok,
+                                               ...))
+        fleet.run()
+
+    ``capacity`` (job slots) and ``rank`` are static — they size the
+    stacked pool the one compiled program closes over; everything that
+    changes while the fleet runs (which jobs, their horizons, their
+    activity) is data. ``deploy=`` an ``AdapterRegistry`` built on the
+    same base to hot-load each artifact the moment it exports."""
+
+    def __init__(self, cfg: ModelConfig, base_params: Params, *,
+                 tokenizer=None, capacity: int = 4, rank: int = 8,
+                 alpha: float = 16.0, rows_per_job: int = 4,
+                 peak_lr: float = 5e-4, initial_lr: float = 1e-5,
+                 min_lr: float = 1e-6, warmup_steps: int = 10,
+                 weight_decay: float = 0.1, grad_clip_norm: float = 1.0,
+                 seed: int = 123, log_every: int = 10,
+                 export_dir: Optional[str] = None,
+                 deploy=None, compile_telemetry: bool = True):
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        if rank < 1:
+            raise ValueError("rank must be >= 1")
+        if rows_per_job < 1:
+            raise ValueError("rows_per_job must be >= 1")
+        self.cfg = cfg
+        self.tokenizer = tokenizer
+        self.capacity = int(capacity)
+        self.rank = int(rank)
+        self.alpha = float(alpha)
+        self.rows_per_job = int(rows_per_job)
+        self.seed = int(seed)
+        self.log_every = max(1, int(log_every))
+        self.export_dir = export_dir
+        self.deploy = deploy
+        self.jobs: List[FinetuneJob] = []
+        self.global_step = 0
+        self.tokens_seen = 0
+        self.preempted = False
+        self._pending_jobs: collections.deque = collections.deque()
+        self._slots: List[Optional[FinetuneJob]] = [None] * self.capacity
+        self._pending_metrics: List = []
+        self._last_fetched: Optional[Dict[str, Any]] = None
+        self._n_admitted = 0
+        self.state = init_fleet_state(cfg, base_params,
+                                      capacity=self.capacity,
+                                      rank=self.rank,
+                                      rng=jax.random.PRNGKey(self.seed))
+        self._step_fn = make_fused_train_step(
+            cfg, capacity=self.capacity, peak_lr=peak_lr,
+            initial_lr=initial_lr, min_lr=min_lr,
+            warmup_steps=warmup_steps, weight_decay=weight_decay,
+            grad_clip_norm=grad_clip_norm)
+        self._watcher: Optional[CompileWatcher] = None
+        if compile_telemetry:
+            self._watcher = CompileWatcher(self._step_fn,
+                                           label="fused_step")
+            self._step_fn = self._watcher
+        #: test/fault-injection hook, called after every fused step with
+        #: the engine (the serving FaultHooks pattern): lets tests poison
+        #: a slot mid-run to prove co-residency isolation
+        self.on_step: Optional[Callable[["FusedLoRATrainer"], None]] = None
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def n_recompiles(self) -> int:
+        return self._watcher.n_recompiles if self._watcher is not None \
+            else 0
+
+    @property
+    def metrics_sink(self):
+        return get_metrics()
+
+    def slot_names(self) -> List[str]:
+        return [job.name if job is not None else f"slot{j}"
+                for j, job in enumerate(self._slots)]
+
+    def stats(self) -> Dict[str, Any]:
+        by_status: Dict[str, int] = {}
+        for job in self.jobs:
+            by_status[job.status] = by_status.get(job.status, 0) + 1
+        return {
+            "capacity": self.capacity,
+            "rank": self.rank,
+            "n_jobs": len(self.jobs),
+            "jobs": {j.name: {"status": j.status, "steps": j.steps_done,
+                              "final_loss": j.final_loss,
+                              "artifact": j.artifact}
+                     for j in self.jobs},
+            "by_status": by_status,
+            "fused_steps": self.global_step,
+            "tokens_seen": self.tokens_seen,
+            "recompiles": self.n_recompiles,
+        }
+
+    # -- job lifecycle -----------------------------------------------------
+
+    def add_job(self, job: FinetuneJob) -> FinetuneJob:
+        if any(j.name == job.name for j in self.jobs):
+            raise ValueError(f"job '{job.name}' already queued")
+        if _FREE_SLOT_RE.fullmatch(job.name):
+            raise ValueError(
+                f"job name '{job.name}' collides with the free-slot "
+                "placeholder names in per-job telemetry (slot<N>)")
+        if job.total_steps < 1:
+            raise ValueError(f"job '{job.name}' has no training steps")
+        self.jobs.append(job)
+        self._pending_jobs.append(job)
+        return job
+
+    def _free_slots(self) -> List[int]:
+        return [j for j, s in enumerate(self._slots) if s is None]
+
+    def _running(self) -> List[FinetuneJob]:
+        return [s for s in self._slots if s is not None]
+
+    def _admit_pending(self) -> None:
+        for j in self._free_slots():
+            if not self._pending_jobs:
+                break
+            job = self._pending_jobs.popleft()
+            self._admit(job, j)
+
+    def _admit(self, job: FinetuneJob, slot: int) -> None:
+        """Hot-join: initialize the slot's pool row (fresh per-job
+        kaiming A / zero B), zero its moments and count — all functional
+        row writes, never a recompile."""
+        self._n_admitted += 1
+        init = job.init
+        if init is None:
+            init = init_lora_params(
+                self.cfg, self.state["frozen"],
+                jax.random.PRNGKey(self.seed + 1 + self._n_admitted),
+                rank=self.rank)
+        idx = jnp.asarray(slot, jnp.int32)
+        self.state["trainable"] = jax.tree_util.tree_map(
+            lambda pool, leaf: pool.at[idx].set(leaf.astype(pool.dtype)),
+            self.state["trainable"], init)
+        self._zero_slot_opt(slot)
+        job.slot = slot
+        job.status = "running"
+        job.t_admitted = time.monotonic()
+        self._slots[slot] = job
+        self.metrics_sink.event(
+            "finetune_job_start", step=self.global_step, job_id=job.name,
+            slot=slot, total_steps=job.total_steps,
+            n_records=job.n_records, n_epochs=job.n_epochs,
+            rows_per_step=self.rows_per_job)
+        logger.info("Fleet job '%s' joined slot %d (%d steps over %d "
+                    "epochs).", job.name, slot, job.total_steps,
+                    job.n_epochs)
+
+    def _zero_slot_opt(self, slot: int) -> None:
+        idx = jnp.asarray(slot, jnp.int32)
+        zero_row = lambda t: jax.tree_util.tree_map(
+            lambda a: a.at[idx].set(jnp.zeros(a.shape[1:], a.dtype)), t)
+        self.state["mu"] = zero_row(self.state["mu"])
+        self.state["nu"] = zero_row(self.state["nu"])
+        self.state["counts"] = self.state["counts"].at[idx].set(0)
+
+    def _zero_slot_row(self, slot: int) -> None:
+        """Zero a retired slot's pool row: padding rows clamp their
+        gather to row 0, and 0 × NaN is NaN — a poisoned row must never
+        outlive its job."""
+        idx = jnp.asarray(slot, jnp.int32)
+        self.state["trainable"] = jax.tree_util.tree_map(
+            lambda a: a.at[idx].set(jnp.zeros(a.shape[1:], a.dtype)),
+            self.state["trainable"])
+        self._zero_slot_opt(slot)
+
+    # -- the fused loop ----------------------------------------------------
+
+    def _build_batch(self) -> Dict[str, np.ndarray]:
+        """Stack each running slot's next rows via the ONE fused-batch
+        constructor; free slots contribute zero rows with ``job_id = -1``
+        (clamped gather × zero scale × zero loss weight — structurally
+        inert)."""
+        entries, horizons = [], np.ones((self.capacity,), np.int32)
+        for j, job in enumerate(self._slots):
+            if job is None:
+                entries.append(None)
+                continue
+            inp, tgt, w = job.next_rows()
+            entries.append({"inputs": inp, "targets": tgt, "weights": w})
+            horizons[j] = job.total_steps
+        return stack_fleet_batch(entries, capacity=self.capacity,
+                                 scaling=self.alpha / self.rank,
+                                 horizon=horizons)
+
+    def run(self) -> "FusedLoRATrainer":
+        """Train every queued job to completion (admitting into freed
+        slots as earlier jobs finish), exporting each artifact the moment
+        its job is done. Returns self."""
+        t0 = time.monotonic()
+        split = fleet_flops_split(self.cfg, self.rank)
+        self.metrics_sink.event(
+            "finetune_fleet", phase="start", n_jobs=len(self.jobs),
+            capacity=self.capacity, rank=self.rank, alpha=self.alpha,
+            rows_per_job=self.rows_per_job,
+            flops_per_token_base=split["flops_per_token_base"],
+            flops_per_token_adapter=split["flops_per_token_adapter"])
+        self._admit_pending()
+        window_tokens, window_t0 = 0, time.perf_counter()
+        try:
+            while self._running():
+                batch = self._build_batch()
+                self.state, metrics = self._step_fn(self.state, batch)
+                if self._watcher is not None and self.global_step == 0:
+                    # the one legitimate compile happened; anything after
+                    # this (join, finish, retire) is a recompile event
+                    self._watcher.freeze()
+                self.global_step += 1
+                n_tok = int(batch["active"].sum()) * self.rows_per_job \
+                    * self.cfg.context_length
+                self.tokens_seen += n_tok
+                window_tokens += n_tok
+                self._post_metrics(metrics)
+                if self.on_step is not None:
+                    self.on_step(self)
+                due = []
+                for job in self._running():
+                    job.steps_done += 1
+                    if job.steps_done >= job.total_steps:
+                        due.append(job)
+                if due or self.global_step % self.log_every == 0:
+                    self._flush(window_tokens,
+                                time.perf_counter() - window_t0)
+                    window_tokens, window_t0 = 0, time.perf_counter()
+                    for job in due:
+                        if job.status == "running":
+                            self._finish(job)
+                    self._admit_pending()
+        except KeyboardInterrupt:
+            self.preempted = True
+            logger.warning("Fleet interrupted at fused step %d.",
+                           self.global_step)
+            raise
+        finally:
+            self._flush(window_tokens, time.perf_counter() - window_t0)
+            done = sum(1 for j in self.jobs if j.status == "done")
+            failed = sum(1 for j in self.jobs if j.status == "failed")
+            self.metrics_sink.event(
+                "finetune_fleet", phase="end", n_jobs=len(self.jobs),
+                jobs_done=done, jobs_failed=failed,
+                seconds=round(time.monotonic() - t0, 3))
+        return self
+
+    def _post_metrics(self, metrics: Dict[str, Any]) -> None:
+        """Deferred-fetch discipline: post the (J,)-array DMAs now,
+        convert to host values only at flush cadence."""
+        keep = {}
+        for key in _FETCHED_METRICS:
+            v = metrics[key]
+            try:
+                v.copy_to_host_async()
+            except (AttributeError, RuntimeError):
+                pass
+            keep[key] = v
+        for v in metrics["health"].values():
+            try:
+                v.copy_to_host_async()
+            except (AttributeError, RuntimeError):
+                pass
+        keep["health"] = metrics["health"]
+        self._pending_metrics.append((self.global_step, keep))
+
+    def _flush(self, window_tokens: int, window_s: float) -> None:
+        """Fetch pending per-step metrics (explicit ``jax.device_get`` —
+        the sanctioned cadence fetch), retire any job that went
+        non-finite, and emit the fleet's metrics + per-job health rows."""
+        if not self._pending_metrics:
+            return
+        pending, self._pending_metrics = self._pending_metrics, []
+        fetched = jax.device_get([m for _, m in pending])
+        for (step, _), vals in zip(pending, fetched):
+            for j, job in enumerate(self._slots):
+                if job is None or job.status != "running":
+                    continue
+                job.supervised_tokens += float(vals["weights"][j])
+                if not bool(vals["finite"][j]):
+                    self._fail(job, step=step, reason="non_finite",
+                               loss=float(vals["loss"][j]),
+                               grad_norm=float(vals["grad_norm"][j]))
+        last_step, _ = pending[-1]
+        last = fetched[-1]
+        self._last_fetched = last
+        for j, job in enumerate(self._slots):
+            if job is not None and job.status == "running":
+                job.final_loss = float(last["loss"][j])
+        names = self.slot_names()
+        active = [j for j in self._running() if j.status == "running"]
+        tok_s = window_tokens / window_s if window_s > 0 else 0.0
+        self.metrics_sink.log_metrics(
+            last_step, fleet=True, tok_s=round(tok_s, 1),
+            active_jobs=len(active),
+            jobs_done=sum(1 for j in self.jobs if j.status == "done"),
+            jobs_failed=sum(1 for j in self.jobs
+                            if j.status == "failed"),
+            jobs_pending=len(self._pending_jobs))
+        h = last["health"]
+        self.metrics_sink.log_health(
+            last_step, names, fleet=True,
+            loss=[round(float(x), 6) for x in last["loss"]],
+            lr=[round(float(x), 8) for x in last["lr"]],
+            grad_norm=[round(float(x), 8) for x in h["grad_norm"]],
+            param_norm=[round(float(x), 8) for x in h["param_norm"]],
+            update_norm=[round(float(x), 8) for x in h["update_norm"]],
+            update_ratio=[round(float(x), 10)
+                          for x in h["update_ratio"]],
+            first_nonfinite=(
+                names[int(h["first_nonfinite"])]
+                if 0 <= int(h["first_nonfinite"]) < len(names) else None))
+        if active:
+            logger.info(
+                "fleet step %d: %d active, %.0f tok/s, losses %s",
+                last_step, len(active), tok_s,
+                ", ".join(f"{j.name}={j.final_loss:.3f}"
+                          for j in active if j.final_loss is not None))
+
+    def _fail(self, job: FinetuneJob, step: int, reason: str,
+              loss: Optional[float] = None,
+              grad_norm: Optional[float] = None) -> None:
+        """Retire ONE sick job (non-finite signal, or a dataset that
+        never produced a supervised token): for the non-finite case its
+        in-graph updates were already being skipped (params/moments kept
+        finite-side), so co-trained jobs never saw a single poisoned
+        value. The slot frees for the next pending job; no artifact is
+        exported."""
+        slot = job.slot
+        job.status = "failed"
+        if reason == "non_finite":
+            job.error = (f"non-finite training signal at fused step "
+                         f"{step} (loss={loss}, grad_norm={grad_norm})")
+        else:
+            job.error = (f"retired at fused step {step}: {reason}")
+        self._slots[slot] = None
+        job.slot = None
+        self._zero_slot_row(slot)
+        fields = {}
+        if loss is not None:
+            fields["loss"] = loss
+        if grad_norm is not None:
+            fields["grad_norm"] = grad_norm
+        self.metrics_sink.event(
+            "finetune_job_failed", step=step, job_id=job.name,
+            reason=reason, slot=slot, steps=job.steps_done, **fields)
+        logger.warning("Fleet job '%s' retired (%s at step %d); "
+                       "co-trained jobs unaffected.", job.name, reason,
+                       step)
+
+    def _export_path(self, job: FinetuneJob) -> str:
+        if job.export_path:
+            return job.export_path
+        base = self.export_dir or "adapters"
+        return os.path.join(base, f"{job.name}.npz")
+
+    def _finish(self, job: FinetuneJob) -> None:
+        """Per-JOB export at job completion (not run end): slice the
+        job's adapter out of the pool, write the standard artifact
+        (atomic tmp+rename, fingerprint — models/lora.save_adapter),
+        optionally hot-load it into the deploy registry, free the slot.
+
+        A job whose ledger shows ZERO supervised tokens (every row fully
+        loss-masked — e.g. a template that overflows the context) never
+        trained: exporting its zero-delta adapter as 'done' would
+        silently deploy an untrained tenant, so it retires as failed
+        instead."""
+        if job.supervised_tokens <= 0:
+            self._fail(job, step=self.global_step,
+                       reason="no_supervised_tokens")
+            return
+        slot = job.slot
+        lora = jax.tree_util.tree_map(lambda a: a[slot],
+                                      self.state["trainable"])
+        path = self._export_path(job)
+        save_adapter(path, lora, rank=self.rank, alpha=self.alpha,
+                     cfg=self.cfg)
+        job.artifact = path
+        job.status = "done"
+        self._slots[slot] = None
+        job.slot = None
+        self._zero_slot_row(slot)
+        self.metrics_sink.event(
+            "adapter_save", step=self.global_step, path=path,
+            job_id=job.name, rank=self.rank, alpha=self.alpha,
+            n_params=count_lora_params(lora),
+            fingerprint=adapter_fingerprint(self.cfg))
+        deployed = False
+        if self.deploy is not None:
+            try:
+                self.deploy.replace(job.name, path)
+                deployed = True
+            except Exception as e:      # noqa: BLE001 — a deploy-side
+                # refusal (capacity, fingerprint) must not kill the
+                # still-training fleet; the artifact is durable on disk
+                logger.warning("Deploy hop for '%s' failed: %s",
+                               job.name, e)
+        self.metrics_sink.event(
+            "finetune_job_done", step=self.global_step, job_id=job.name,
+            steps=job.steps_done, final_loss=job.final_loss,
+            artifact=path, deployed=deployed,
+            seconds=round(time.monotonic() - (job.t_admitted or 0), 3))
+        logger.info("Fleet job '%s' done after %d steps (loss %.4f): "
+                    "exported %s%s.", job.name, job.steps_done,
+                    job.final_loss if job.final_loss is not None
+                    else float("nan"), path,
+                    ", deployed" if deployed else "")
+
+
+# ---------------------------------------------------------------------------
+# CLI entry (--mode finetune_fleet; main.py dispatches here)
+# ---------------------------------------------------------------------------
+
+def run_finetune_fleet(args, comps, metric_logger) -> FusedLoRATrainer:
+    """Train a fleet of per-tenant LoRA jobs fused on one base model:
+    ``--fleet_jobs name=records.json,...`` each becomes a job; every
+    finished job exports ``<export_dir>/<name>.npz`` — the exact
+    artifacts ``--serve_adapters`` hot-loads."""
+    from building_llm_from_scratch_tpu.serving.frontend import (
+        parse_adapter_specs,
+    )
+    from building_llm_from_scratch_tpu.utils.io import read_json_file
+
+    specs = parse_adapter_specs(args.fleet_jobs, flag="--fleet_jobs")
+    export_dir = args.fleet_export_dir or os.path.join(
+        args.output_dir, "adapters")
+    engine = FusedLoRATrainer(
+        comps.cfg, comps.params, tokenizer=comps.tokenizer,
+        capacity=(args.fleet_capacity or len(specs)),
+        rank=args.lora_rank, alpha=args.lora_alpha,
+        rows_per_job=args.fleet_rows_per_job,
+        peak_lr=args.lr, initial_lr=args.initial_lr, min_lr=args.min_lr,
+        warmup_steps=args.warmup_steps, seed=args.seed,
+        log_every=(args.log_every or 10), export_dir=export_dir)
+    for name, path in specs.items():
+        records = read_json_file(path)
+        engine.add_job(FinetuneJob.from_records(
+            name, records, comps.tokenizer,
+            max_length=comps.cfg.context_length,
+            rows_per_step=args.fleet_rows_per_job,
+            n_epochs=args.n_epochs, pad_token_id=comps.cfg.eos_id,
+            seed=args.seed, style=args.fleet_style,
+            export_path=os.path.join(export_dir, f"{name}.npz")))
+    engine.run()
+    done = [j.name for j in engine.jobs if j.status == "done"]
+    failed = [j.name for j in engine.jobs if j.status == "failed"]
+    logger.info("Fleet complete: %d/%d jobs exported (%s)%s.",
+                len(done), len(engine.jobs), ", ".join(done) or "none",
+                f"; failed: {', '.join(failed)}" if failed else "")
+    metric_logger.close()
+    return engine

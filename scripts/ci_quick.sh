@@ -188,6 +188,89 @@ print(f"lora serving smoke ok: 9/9 requests ({by_adapter.count('base')} "
       f"base + 6 adapter), {len(loads)} adapter_loads, 0 recompiles")
 EOF
 
+echo "== fused multi-LoRA finetune smoke (fleet train -> serve, CPU) =="
+JAX_PLATFORMS=cpu python - <<'EOF' || exit 1
+import json, os, tempfile
+d = tempfile.mkdtemp()
+# 2 debug-size tenant jobs trained FUSED through one base forward/backward
+# (--mode finetune_fleet), then their exported artifacts served as mixed
+# multi-tenant traffic — the whole train->deploy hop, zero recompiles in
+# both processes. 'plain' style: the Alpaca template alone would overflow
+# the --debug 16-token context and zero every loss weight.
+jobs = {}
+for name, vocab in (("joba", "abcd"), ("jobb", "wxyz")):
+    path = os.path.join(d, f"{name}.json")
+    with open(path, "w") as f:
+        json.dump([{"instruction": vocab[i % 4] * 2, "input": "",
+                    "output": vocab[(i + 1) % 4] * 3} for i in range(8)], f)
+    jobs[name] = path
+out = os.path.join(d, "out")
+mj = os.path.join(d, "fleet_metrics.jsonl")
+from building_llm_from_scratch_tpu.args import get_args
+from building_llm_from_scratch_tpu.main import main
+fleet = main(get_args([
+    "--mode", "finetune_fleet", "--debug", "--byte_tokenizer",
+    "--output_dir", out,
+    "--fleet_jobs", ",".join(f"{n}={p}" for n, p in jobs.items()),
+    "--fleet_rows_per_job", "2", "--fleet_style", "plain",
+    "--n_epochs", "2", "--lora_rank", "4", "--lora_alpha", "8",
+    "--warmup_steps", "2", "--log_every", "2",
+    "--metrics_jsonl", mj,
+]))
+assert all(j.status == "done" for j in fleet.jobs), fleet.stats()
+arts = {j.name: j.artifact for j in fleet.jobs}
+assert all(os.path.isfile(p) for p in arts.values()), arts
+assert fleet.n_recompiles == 0, "fleet join/finish recompiled"
+rows = [json.loads(l) for l in open(mj)]
+saves = [r for r in rows if r.get("event") == "adapter_save"]
+assert len(saves) >= 2, f"expected >=2 adapter_save events: {saves}"
+assert {s.get("job_id") for s in saves} == set(jobs), saves
+assert not [r for r in rows if r.get("event") == "recompile"], "recompile"
+dones = [r for r in rows if r.get("event") == "finetune_job_done"]
+assert len(dones) == 2, dones
+# deploy hop: serve BOTH fresh artifacts + base traffic concurrently
+reqs = os.path.join(d, "requests.jsonl")
+with open(reqs, "w") as f:
+    for i in range(9):
+        f.write(json.dumps({"prompt": "abcd"[: 1 + i % 4],
+                            "max_new_tokens": 4, "ignore_eos": True,
+                            "seed": i,
+                            "adapter": [None, "joba", "jobb"][i % 3]})
+                + "\n")
+res = os.path.join(d, "results.jsonl")
+mj2 = os.path.join(d, "serve_metrics.jsonl")
+engine = main(get_args([
+    "--mode", "serve", "--debug", "--byte_tokenizer", "--data_dir", d,
+    "--serve_prompts", reqs, "--serve_out", res,
+    "--serve_slots", "4", "--serve_max_queue", "9",
+    "--serve_adapters", f"joba={arts['joba']},jobb={arts['jobb']}",
+    "--metrics_jsonl", mj2,
+]))
+results = [json.loads(l) for l in open(res)]
+assert len(results) == 9, f"expected 9 results, got {len(results)}"
+assert all(r["finish_reason"] == "length" for r in results), results
+by_adapter = sorted(r.get("adapter", "base") for r in results)
+assert by_adapter == ["base"] * 3 + ["joba"] * 3 + ["jobb"] * 3, by_adapter
+rows2 = [json.loads(l) for l in open(mj2)]
+loads = [r for r in rows2 if r.get("event") == "adapter_load"]
+assert len(loads) >= 2, f"expected >=2 adapter_load events: {loads}"
+assert not [r for r in rows2 if r.get("event") == "recompile"], "recompile"
+assert engine.n_recompiles == 0
+import shutil
+shutil.copy(mj, "/tmp/_ci_fleet_metrics.jsonl")
+print(f"fused finetune smoke ok: 2 jobs fused ({fleet.global_step} fused "
+      f"steps), {len(saves)} adapter_saves -> {len(loads)} adapter_loads, "
+      f"9/9 mixed requests, 0 recompiles across train->deploy")
+EOF
+# renderer grows a fused-finetune section: per-job losses, export
+# timeline, FLOPs split — assert it opens on the smoke's telemetry
+render_out=$(JAX_PLATFORMS=cpu python scripts/summarize_metrics.py \
+    /tmp/_ci_fleet_metrics.jsonl --out /tmp/_ci_fleet_metrics.png) \
+    || exit 1
+echo "$render_out" | grep -q "fused multi-LoRA finetuning" || exit 1
+echo "$render_out" | grep -q "adapter exports" || exit 1
+echo "fleet renderer ok"
+
 echo "== KV memory engine smoke (prefix cache + chunked prefill + int8, CPU) =="
 JAX_PLATFORMS=cpu python - <<'EOF' || exit 1
 import json, os, tempfile

@@ -78,9 +78,11 @@ BASELINE_JSONL_DIR = os.path.join(REPO_ROOT, "results", "perf", "baseline")
 
 #: The default gate benches: debug-size workloads that finish in seconds
 #: on CPU (bench.py MICRO_BENCHES). One raw train step, one grad-accum
-#: step, one continuous-batching engine run — together they fingerprint
-#: the train step builder and the serving engine's whole program family.
-GATE_BENCHES = ("micro_train", "micro_accum", "micro_serve")
+#: step, one continuous-batching engine run, one fused multi-LoRA step —
+#: together they fingerprint the train step builder, the serving
+#: engine's whole program family, and the fused-finetune step.
+GATE_BENCHES = ("micro_train", "micro_accum", "micro_serve",
+                "micro_lora_fusion")
 
 #: Env fields whose drift invalidates structural comparability (a
 #: different XLA counts different FLOPs) — reported, not silently eaten.
@@ -271,9 +273,13 @@ def cmd_update_baseline(args):
     env = perf.bench_env()
     for name in names:
         res, arm_jsonl = fresh[name]
-        rel_jsonl = os.path.join("results", "perf", "baseline",
-                                 f"{name}.jsonl")
-        shutil.copyfile(arm_jsonl, os.path.join(REPO_ROOT, rel_jsonl))
+        # through BASELINE_JSONL_DIR, never a hardcoded repo path: the
+        # test suite monkeypatches the dir at a tmp location, and the
+        # hardcoded join made its --update-baseline e2e rewrite the
+        # COMMITTED arm files on every test run
+        dst = os.path.join(BASELINE_JSONL_DIR, f"{name}.jsonl")
+        rel_jsonl = os.path.relpath(dst, REPO_ROOT)
+        shutil.copyfile(arm_jsonl, dst)
         baseline["benches"][name] = {
             "metric": res.metric,
             "fingerprint": perf.structural_part(res.fingerprint),

@@ -20,6 +20,7 @@ summary still works without it).
 import argparse
 import json
 import os
+import re
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -464,6 +465,95 @@ def summarize_compile(metrics, events):
                 print(f"    {d.get('leaf')}: {d.get('was')} -> {d.get('now')}")
 
 
+def summarize_fleet(metrics, events, health):
+    """Fused multi-LoRA finetuning section (training/lora_fusion.py):
+    per-job loss trajectory, job completion/failure summary, the
+    adapter-export timeline (each tenant's deployment unblocks at ITS
+    job's finish, not run end), and the fused-step FLOPs split — how much
+    of each step is the shared frozen base vs the per-job adapters."""
+    fleet_ev = [e for e in events if e["event"] == "finetune_fleet"]
+    starts = [e for e in events if e["event"] == "finetune_job_start"]
+    dones = [e for e in events if e["event"] == "finetune_job_done"]
+    fails = [e for e in events if e["event"] == "finetune_job_failed"]
+    saves = [e for e in events
+             if e["event"] == "adapter_save" and e.get("job_id")]
+    if not (fleet_ev or starts or dones or fails):
+        return
+    print("\n-- fused multi-LoRA finetuning --")
+    start_ev = next((e for e in fleet_ev if e.get("phase") == "start"),
+                    None)
+    end_ev = next((e for e in fleet_ev if e.get("phase") == "end"), None)
+    if start_ev:
+        print(f"  fleet: {start_ev.get('n_jobs', '?')} job(s) on "
+              f"{start_ev.get('capacity', '?')} slot(s), rank "
+              f"{start_ev.get('rank', '?')}, "
+              f"{start_ev.get('rows_per_job', '?')} rows/job/step")
+    if end_ev:
+        print(f"  outcome: {end_ev.get('jobs_done', 0)} done, "
+              f"{end_ev.get('jobs_failed', 0)} failed in "
+              f"{end_ev.get('seconds', 0):.1f}s")
+    fleet_rows = [m for m in metrics if m.get("fleet")]
+    if fleet_rows:
+        steps, tok = column(fleet_rows, "tok_s")
+        if tok:
+            print(f"  throughput: {sum(tok) / len(tok):,.0f} tok/s mean "
+                  f"over {len(tok)} cadence window(s)")
+    # per-job loss trajectory from the fleet's health rows (groups =
+    # slot/job names; a job's column tracks it while it occupies a slot)
+    loss_rows = [h for h in health
+                 if h.get("fleet") and isinstance(h.get("loss"), list)
+                 and isinstance(h.get("groups"), list)
+                 and len(h["loss"]) == len(h["groups"])]
+    by_job = {}
+    free_slot = re.compile(r"slot\d+")   # the engine's free-slot
+    # placeholder (job names matching it are refused at add_job)
+    for h in loss_rows:
+        for name, loss in zip(h["groups"], h["loss"]):
+            if free_slot.fullmatch(name):
+                continue
+            if isinstance(loss, (int, float)):
+                by_job.setdefault(name, []).append((h["step"], loss))
+    if by_job:
+        print("  per-job loss (first -> last):")
+        for name in sorted(by_job):
+            tr = by_job[name]
+            print(f"    {name:<14} {tr[0][1]:8.4f} -> {tr[-1][1]:8.4f} "
+                  f"over steps {tr[0][0]}..{tr[-1][0]}")
+    # export timeline: when each tenant's artifact became deployable,
+    # relative to the fleet start (slow jobs must not gate fast ones)
+    t0 = start_ev.get("time") if start_ev else (
+        saves[0].get("time") if saves else None)
+    if saves and t0:
+        print("  adapter exports (deployment-ready):")
+        for e in sorted(saves, key=lambda e: e.get("time", 0)):
+            done_ev = next((d for d in dones
+                            if d.get("job_id") == e.get("job_id")), {})
+            dep = ", hot-deployed" if done_ev.get("deployed") else ""
+            print(f"    +{e.get('time', 0) - t0:7.2f}s  "
+                  f"{e.get('job_id', '?'):<14} {e.get('path', '')}{dep}")
+    for e in fails:
+        print(f"  !! job {e.get('job_id')} retired at step "
+              f"{e.get('steps', '?')}: {e.get('reason')} "
+              f"(loss={e.get('loss')}, grad_norm={e.get('grad_norm')}) "
+              "— co-trained jobs unaffected")
+    # FLOPs split: analytic base-vs-adapter share + the fused step's
+    # HLO-counted total (compile event label fused_step)
+    if start_ev and isinstance(start_ev.get("flops_per_token_base"),
+                               (int, float)):
+        base = start_ev["flops_per_token_base"]
+        adp = start_ev.get("flops_per_token_adapter", 0) or 0
+        share = adp / (base + adp) if base + adp else 0.0
+        line = (f"  fused-step FLOPs/token (analytic): base "
+                f"{base:.3g} + adapters {adp:.3g} "
+                f"({100 * share:.1f}% adapter share)")
+        comp = next((e for e in events if e["event"] == "compile"
+                     and e.get("label") == "fused_step"
+                     and isinstance(e.get("flops"), (int, float))), None)
+        if comp:
+            line += f"; HLO {comp['flops']:.3g} flops/step"
+        print(line)
+
+
 def summarize_health(health, top_k: int = 6):
     """Per-layer-group grad-norm trajectory table: one row per health
     cadence, one column per group (widest-swinging ``top_k`` groups when
@@ -736,6 +826,7 @@ def main(argv=None):
     header, metrics, events, health = load_rows(args.jsonl)
     summarize(header, metrics, events)
     summarize_compile(metrics, events)
+    summarize_fleet(metrics, events, health)
     summarize_serving(metrics, events)
     summarize_health(health)
     if args.trace:
