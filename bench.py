@@ -881,6 +881,140 @@ def bench_serve_prefix(n_requests=10, prefix_len=192, suffix_len=8,
                    unit="tokens/sec", detail=detail)
 
 
+def _spec_bench_model(ctx=128, train_steps=60, period=7, seed=0):
+    """A tiny byte-ish model TRAINED briefly on a cyclic token stream —
+    the honest 'repetitive/greedy workload' for the speculative-decoding
+    A/B. An untrained model's greedy output is position-dependent noise
+    (random learned positions), which no self-history drafter can
+    predict; ~30 train steps on a short cycle make greedy decode
+    actually CONTINUE the cycle, so the n-gram drafter earns its
+    acceptance the same way it does on real templated/extractive
+    traffic. Returns (cfg, trained_params, token_stream)."""
+    from building_llm_from_scratch_tpu.configs import ModelConfig
+    from building_llm_from_scratch_tpu.models import init_params
+    from building_llm_from_scratch_tpu.training import (
+        build_optimizer,
+        init_train_state,
+        make_train_step,
+    )
+
+    cfg = ModelConfig(name="spec-bench-tiny", vocab_size=96,
+                      context_length=ctx, emb_dim=32, n_heads=2,
+                      n_layers=2, hidden_dim=64, n_kv_groups=2,
+                      norm="layernorm", positional="learned",
+                      activation="gelu", drop_rate=0.0, eos_id=1)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(seed)
+    cycle = rng.integers(2, cfg.vocab_size, (period,)).astype(np.int32)
+    stream = np.tile(cycle, (4 * ctx) // period + 2)
+
+    def batch(bs=4):
+        starts = rng.integers(0, period, (bs,))
+        rows = np.stack([stream[s: s + ctx + 1] for s in starts])
+        return {"inputs": rows[:, :-1].astype(np.int32),
+                "targets": rows[:, 1:].astype(np.int32),
+                "weights": np.ones((bs, ctx), np.float32)}
+
+    opt = build_optimizer(total_steps=train_steps + 2)
+    state = init_train_state(params, opt, jax.random.PRNGKey(0))
+    step = make_train_step(cfg, opt)
+    for _ in range(train_steps):
+        state, m = step(state, batch())
+    jax.device_get(m["loss"])
+    return cfg, state["trainable"], stream
+
+
+def bench_serve_spec(n_requests=8, max_new=96, prompt_len=24, n_slots=4,
+                     ks=(2, 4, 8)):
+    """Speculative-decoding A/B (serving/spec.py + verify_slots): the
+    SAME repetitive greedy request set decoded spec-off vs spec-on at
+    k in ``ks`` — per arm: decode tok/s, TPOT p50/p95 (the per-token
+    latency speculation exists to attack), acceptance rate, recompiles.
+
+    The workload is what prompt-lookup drafting is FOR: a briefly
+    trained tiny model whose greedy continuation repeats its context
+    (templated prompts / extraction / code in miniature) — see
+    ``_spec_bench_model``. Tokens are bit-identical across arms (the
+    accept rule is exact; test-pinned in tests/test_spec.py), so every
+    arm decodes the same work. Acceptance bar: >= 1.3x decode tok/s at
+    k=4 with ZERO recompiles across acceptance churn.
+
+    CPU numbers (tiny model, dispatch-bound ticks) UNDERSTATE the TPU
+    win: there decode is weight-streaming-bound, so k+1 verify
+    positions cost ~one decode step while committing up to k+1
+    tokens."""
+    import time
+
+    from building_llm_from_scratch_tpu.generate import _bucket
+    from building_llm_from_scratch_tpu.serving import (
+        DecodeEngine,
+        SamplingParams,
+    )
+
+    if _QUICK:
+        n_requests, max_new = min(n_requests, 4), min(max_new, 16)
+    t_train = time.perf_counter()
+    # quick mode also trims the drafter-training iterations (acceptance
+    # drops a little; the fingerprint-relevant shapes are unchanged)
+    cfg, params, stream = _spec_bench_model(
+        train_steps=20 if _QUICK else 60)
+    train_s = time.perf_counter() - t_train
+    prompts = [stream[s: s + prompt_len].astype(np.int32)
+               for s in range(n_requests)]
+    sp = SamplingParams(max_new_tokens=max_new, ignore_eos=True)
+
+    def run_arm(spec_k):
+        eng = DecodeEngine(cfg, params, n_slots=n_slots,
+                           max_queue=n_requests,
+                           max_len=_bucket(prompt_len + max_new),
+                           warmup_prompt_cap=prompt_len, spec_k=spec_k)
+        eng.warmup()
+        t0 = time.perf_counter()
+        handles = [eng.submit(p, sp, block=True) for p in prompts]
+        eng.run_until_idle()
+        dt = time.perf_counter() - t0
+        for h in handles:
+            assert len(h.output_ids) == max_new, h.finish_reason
+        stats = eng.stats()
+        # exact per-request TPOT (the engine histogram's sub-ms buckets
+        # are too coarse to resolve a tiny model's per-token latency)
+        tpots = [t for t in (h.tpot_s() for h in handles)
+                 if t is not None]
+        row = {
+            "tok_s": round(n_requests * max_new / dt, 1),
+            "ticks": stats["n_ticks"],
+            "tpot_mean_ms": round(1e3 * float(np.mean(tpots)), 4),
+            "recompiles": eng.n_recompiles,
+        }
+        if spec_k:
+            row["acceptance"] = stats.get("spec_acceptance_ratio", 0.0)
+            row["drafted"] = stats.get("spec_tokens_drafted", 0)
+            row["accepted"] = stats.get("spec_tokens_accepted", 0)
+        assert eng.n_recompiles == 0, "spec traffic recompiled"
+        eng.shutdown()
+        return row
+
+    detail = {"train_seconds": round(train_s, 2),
+              "spec_off": run_arm(0)}
+    headline = None
+    for k in ks:
+        detail[f"spec_k{k}"] = run_arm(k)
+        if k == 4:
+            headline = detail["spec_k4"]["tok_s"]
+    off = detail["spec_off"]
+    if "spec_k4" in detail:
+        on = detail["spec_k4"]
+        detail["decode_tok_s_speedup_k4"] = round(
+            on["tok_s"] / off["tok_s"], 2)
+        if off.get("tpot_mean_ms") and on.get("tpot_mean_ms"):
+            detail["tpot_speedup_k4"] = round(
+                off["tpot_mean_ms"] / on["tpot_mean_ms"], 2)
+    print(json.dumps(detail), flush=True)
+    return _result("serve_spec", f"serve_spec tokens/sec spec-bench-tiny "
+                   f"fp32 {n_requests}req x {max_new}new repetitive-greedy "
+                   "slots4 k4", headline, unit="tokens/sec", detail=detail)
+
+
 def _fleet_batches(cfg, k, rows, seed=0):
     """Per-job synthetic SFT batches (random tokens, Alpaca-style
     prompt-half loss mask) — the same rows feed both A/B arms."""
@@ -1141,6 +1275,48 @@ def bench_micro_lora_fusion():
                    detail={"recompiles": step.n_recompiles})
 
 
+def bench_micro_spec():
+    """Debug-size speculative serving engine (2 slots, 6 requests,
+    k=4): the gate workload for the spec tier — its fingerprint pins
+    the Tq=k+1 verify program's HLO next to the bucketed prefill, so a
+    verify-graph change (a lost candidate position, an accidental extra
+    program, a warmup recompile, acceptance leaking into a compile
+    signature) fails the structural gate with the program named. The
+    model is untrained (acceptance ~0 — irrelevant: structure only)."""
+    from building_llm_from_scratch_tpu.configs import get_config
+    from building_llm_from_scratch_tpu.models import init_params
+    from building_llm_from_scratch_tpu.serving import (
+        DecodeEngine,
+        SamplingParams,
+    )
+
+    n_requests, max_new, prompt_len = 6, 4, 4
+    cfg = get_config("GPT2", "124M", dtype="fp32", debug=True)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(0, cfg.vocab_size,
+                           (n_requests, prompt_len)).astype(np.int32)
+    sp = SamplingParams(max_new_tokens=max_new, ignore_eos=True)
+    engine = DecodeEngine(cfg, params, n_slots=2, max_queue=n_requests,
+                          warmup_prompt_cap=prompt_len, metrics_every=2,
+                          spec_k=4)
+    engine.warmup()
+    t0 = time.perf_counter()
+    handles = [engine.submit(p, sp, block=True) for p in prompts]
+    engine.run_until_idle()
+    dt = time.perf_counter() - t0
+    for h in handles:
+        assert len(h.output_ids) == max_new, h.finish_reason
+    detail = {"recompiles": engine.n_recompiles,
+              "acceptance": engine.stats().get("spec_acceptance_ratio",
+                                               0.0)}
+    engine.shutdown()
+    return _result("micro_spec", "serve tokens/sec GPT2-debug fp32 "
+                   f"{n_requests}req x {max_new}new slots2 spec-k4",
+                   n_requests * max_new / dt, unit="tokens/sec",
+                   detail=detail)
+
+
 BENCHES = {
     "headline": bench_headline,
     "cfg1": bench_cfg1,
@@ -1156,17 +1332,19 @@ BENCHES = {
     "serve_load": bench_serve_load,
     "serve_lora": bench_serve_lora,
     "serve_prefix": bench_serve_prefix,
+    "serve_spec": bench_serve_spec,
     "lora_fusion": bench_lora_fusion,
     "micro_train": bench_micro_train,
     "micro_accum": bench_micro_accum,
     "micro_serve": bench_micro_serve,
     "micro_lora_fusion": bench_micro_lora_fusion,
+    "micro_spec": bench_micro_spec,
 }
 
 #: Micro-benches excluded from ``all`` (they are gate workloads, not
 #: performance claims — their tok/s on a debug model means nothing).
 MICRO_BENCHES = ("micro_train", "micro_accum", "micro_serve",
-                 "micro_lora_fusion")
+                 "micro_lora_fusion", "micro_spec")
 
 
 def run_bench(name: str, repeats: int = 1, quick: bool = False

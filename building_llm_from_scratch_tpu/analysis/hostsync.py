@@ -52,8 +52,16 @@ HOT_PATHS = {
         "DecodeEngine._chunk_tick",
         "DecodeEngine._maybe_store_prefix",
         "DecodeEngine._accept_token",
+        "DecodeEngine._verify_tick",
         "DecodeEngine._pool_args",
         "DecodeEngine._pool_args_for",
+    },
+    "building_llm_from_scratch_tpu/serving/spec.py": {
+        # the drafter runs INSIDE the tick for every spec-enabled slot:
+        # pure host numpy only — one device sync here stalls the whole
+        # co-resident batch every tick
+        "Drafter.propose",
+        "NgramDrafter.propose",
     },
     "building_llm_from_scratch_tpu/serving/adapters.py": {
         # the engine's per-tick / per-admission registry reads: must stay

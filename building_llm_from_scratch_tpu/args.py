@@ -106,6 +106,9 @@ def perform_checks(args) -> None:
                              "(0 = monolithic bucketed prefill).")
         if args.serve_prefix_budget_mb <= 0:
             raise ValueError("--serve_prefix_budget_mb must be > 0.")
+        if args.serve_spec_k < 0:
+            raise ValueError("--serve_spec_k must be >= 0 "
+                             "(0 disables speculative decoding).")
         if args.serve_adapters:
             from building_llm_from_scratch_tpu.serving.frontend import (
                 parse_adapter_specs,
@@ -137,6 +140,7 @@ def perform_checks(args) -> None:
             ("serve_adapters", None), ("serve_adapter_slots", 0),
             ("serve_prefix_cache", "off"), ("serve_prefill_chunk", 0),
             ("serve_kv_quant", "model"), ("serve_prefix_budget_mb", 256.0),
+            ("serve_spec_k", 0),
         ) if getattr(args, name) != default]
         if stray:
             raise ValueError(
@@ -507,6 +511,18 @@ def get_args(argv=None):
                         help="Prefix-store byte budget (MiB of device "
                              "memory for cached prefix KV panes); least-"
                              "recently-used entries evict past it.")
+    parser.add_argument("--serve_spec_k", type=int, default=0,
+                        help="Speculative decoding draft length: each "
+                             "tick an n-gram drafter proposes this many "
+                             "tokens per slot from the slot's own "
+                             "history and ONE compiled verify program "
+                             "scores all k+1 positions — a slot commits "
+                             "1..k+1 tokens per tick, attacking TPOT "
+                             "itself. k is static (zero recompiles at "
+                             "any acceptance rate); engine tokens are "
+                             "bit-identical to spec-off. Per-request "
+                             "opt-out via the 'spec': false field. "
+                             "0 disables (default).")
 
     # Fused multi-LoRA finetuning (--mode finetune_fleet;
     # training/lora_fusion.py)

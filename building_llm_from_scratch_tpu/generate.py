@@ -94,6 +94,71 @@ def sample_tokens_dynamic(logits: jnp.ndarray, keys: jnp.ndarray,
     return jax.vmap(one)(keys, logits, temperature)
 
 
+def sample_tokens_multi(logits: jnp.ndarray, keys: jnp.ndarray,
+                        temperature: jnp.ndarray, top_k: jnp.ndarray,
+                        max_top_k: int) -> jnp.ndarray:
+    """Per-POSITION dynamic sampling for the speculative verify program:
+    ``logits`` (S, Tq, V) scores Tq candidate positions per slot in one
+    forward; each (slot, position) pair samples with ITS OWN key (the
+    ``token_rng`` fold-in for that position's token index) under the
+    slot's temperature/top_k.
+
+    Row (s, j) is computed by exactly the ``sample_tokens_dynamic`` math
+    on a flattened (S*Tq, V) batch — every op in that path is row-wise,
+    so position j of slot s draws the bit-identical token the Tq=1
+    decode path would draw at the same (logits, key, params). That
+    row-equivalence is what makes speculative acceptance EXACT: a
+    committed token is the token the non-speculative engine would have
+    produced (test-pinned)."""
+    S, Tq, V = logits.shape
+    rep = lambda a: jnp.repeat(a, Tq)       # row-major: (s, j) -> s*Tq + j
+    flat = sample_tokens_dynamic(
+        logits.reshape(S * Tq, V),
+        keys.reshape((S * Tq,) + keys.shape[2:]),
+        rep(temperature), rep(top_k), max_top_k)
+    return flat.reshape(S, Tq)
+
+
+def accept_draft_tokens(logits: jnp.ndarray, drafts: jnp.ndarray,
+                        keys: jnp.ndarray, temperature: jnp.ndarray,
+                        top_k: jnp.ndarray, max_top_k: int):
+    """The in-graph speculative accept rule (serving/spec.py is the
+    drafting side; ``models/transformer.verify_slots`` produced
+    ``logits``).
+
+    ``logits`` (S, k+1, V): position j scores the continuation after
+    [last_token, d_1..d_j]. ``drafts`` (S, k) are the proposed tokens
+    d_1..d_k. For every position the ENGINE'S OWN token t_j is drawn
+    first (``sample_tokens_multi`` with that position's fold-in key —
+    argmax when temperature 0); draft d_{j+1} is accepted iff it equals
+    t_j, and the longest accepted prefix is committed as t_0..t_{n_acc}
+    (t_{n_acc} is the correction/bonus token the verify forward gives
+    for free).
+
+    Because the drafter proposes a POINT MASS, exact-match acceptance
+    IS Leviathan-style rejection sampling: a draft x is accepted with
+    probability p(x) (the chance the model's own draw equals it), and a
+    rejected position's committed token is distributed p(· | · != x) —
+    the normalized residual max(0, p - q) for a one-hot q. The committed
+    sequence is therefore not just distribution-preserving but
+    BIT-IDENTICAL to the non-speculative sampler at every acceptance
+    rate: t_j rides the same per-token-index ``token_rng`` key the
+    Tq=1 path would use, and is only committed when its conditioning
+    prefix was itself committed.
+
+    Non-finite guard folded in: committing t_j needs finite logits at
+    position j, so the acceptance chain stops before a poisoned
+    position; ``ok`` (position 0's finiteness) retires the whole row —
+    the same semantics the non-speculative decode guard has.
+
+    Returns (tokens (S, k+1), n_accepted (S,), ok (S,))."""
+    toks = sample_tokens_multi(logits, keys, temperature, top_k, max_top_k)
+    finite = jnp.all(jnp.isfinite(logits), axis=-1)          # (S, k+1)
+    match = (toks[:, :-1] == drafts) & finite[:, 1:]
+    acc = jnp.cumprod(match.astype(jnp.int32), axis=1)       # leading run
+    return toks, jnp.sum(acc, axis=1), finite[:, 0]
+
+
 def _bucket(n: int, step: int = 64, lo: int = 32) -> int:
     """Round up to the compile-shape bucket (multiples of ``step``, floor
     ``lo``) so nearby prompt/budget lengths share one XLA program."""

@@ -169,8 +169,12 @@ def main(args):
     # 5b. fault tolerance: auto-resume discovery (coordinator-resolved and
     #     shared via the output dir so every host restores the SAME
     #     checkpoint), loss watchdog, and the graceful-stop signal handler
-    resume_from = resolve_resume_agreed(getattr(args, "resume", "auto"),
-                                        args.resume_from, args.output_dir)
+    # predicate: a fleet (--mode finetune_fleet) checkpoint in the same
+    # output_dir shares the model_pg_ prefix but cannot restore into the
+    # trainer state — auto-discovery skips it instead of dying mid-load
+    resume_from = resolve_resume_agreed(
+        getattr(args, "resume", "auto"), args.resume_from,
+        args.output_dir, predicate=lambda meta: not meta.get("fleet"))
     watchdog = None
     if getattr(args, "watchdog", "on") == "on" and not (
             comps.policy is not None and comps.policy.name == "fp16"):

@@ -152,6 +152,14 @@ def apply_lora(x: jnp.ndarray, w: jnp.ndarray, node: Optional[Params],
         # the already-scaled (S, O) delta
         delta = lora_bgmv(x[:, 0], a_pool, b_pool, ids, scales)
         return h + delta[:, None].astype(h.dtype)
+    if "aligned" in node:
+        # slot-ALIGNED pool application (fused multi-LoRA training):
+        # the batch's rows are laid out job-contiguously, so each job's
+        # A/B multiplies ONCE against its own (R*T)-row block instead of
+        # being gather-duplicated R-fold per row
+        a, b, s, rows_per_job = node["aligned"]
+        return h + aligned_lora_delta(x, a, b, s,
+                                      rows_per_job).astype(h.dtype)
     return h + lora_delta(x, node, scaling).astype(h.dtype)
 
 
@@ -166,6 +174,29 @@ def lora_delta(x: jnp.ndarray, node: Params, scaling) -> jnp.ndarray:
     if s.ndim == 1:                       # (B,) per-row scales
         s = s[:, None, None]
     return s * delta
+
+
+def aligned_lora_delta(x: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray,
+                       scaling, rows_per_job: int) -> jnp.ndarray:
+    """Slot-aligned per-JOB delta: ``x`` (B, T, in) whose rows are laid
+    out job-contiguously (row block [j*R, (j+1)*R) belongs to job j —
+    the ``stack_fleet_batch`` layout) against a stacked pool ``a``
+    (J, in, r) / ``b`` (J, r, out) with per-job ``scaling`` (J,).
+
+    The mathematical twin of the per-row gather (``_adapter_rows`` +
+    ``lora_delta``) for that layout, WITHOUT materializing each job's
+    A/B once per row: reshape to (J, R*T, in) and batch-matmul each
+    job's block against its adapter exactly once — the backward
+    correspondingly writes each job's gradient block straight into its
+    pool row instead of scatter-adding R duplicates (ROADMAP PR 12
+    follow-up; parity vs the gather path is test-pinned)."""
+    B, T, _ = x.shape
+    J = a.shape[0]
+    xj = x.reshape(J, rows_per_job * T, -1)
+    d = jnp.einsum("jti,jir->jtr", xj, a)
+    d = jnp.einsum("jtr,jro->jto", d, b)
+    s = jnp.asarray(scaling, jnp.float32)[:, None, None]
+    return (s * d).reshape(B, T, -1)
 
 
 def count_lora_params(lora: Params) -> int:

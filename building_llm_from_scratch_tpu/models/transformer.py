@@ -89,6 +89,19 @@ def _block_adp(lb: Params, s) -> Params:
     return {"attn": dict(lb["attn"], s=s), "mlp": dict(lb["mlp"], s=s)}
 
 
+def _aligned_block_adp(lb: Params, s, rows_per_job: int) -> Params:
+    """Per-layer adapter argument for the SLOT-ALIGNED pool path: each
+    projection node routes through ``models/lora.aligned_lora_delta``
+    (one application per job block) instead of the per-row gather. ``lb``
+    leaves are the layer's stacked (J, in, r)/(J, r, out) pool panes."""
+    out = {}
+    for group in ("attn", "mlp"):
+        out[group] = {name: {"aligned": (n["A"], n["B"], s, rows_per_job)}
+                      for name, n in lb[group].items()}
+        out[group]["s"] = None
+    return out
+
+
 def _adapter_rows(pool: Params, scaling: jnp.ndarray, ids: jnp.ndarray):
     """BGMV gather: per-row adapter matrices from the stacked pool.
 
@@ -531,7 +544,26 @@ def forward_hidden(params: Params, cfg: ModelConfig, tokens: jnp.ndarray, *,
 
     x = _embed(cfg, params, tokens, positions, emb_rng, deterministic)
 
-    if adapter is not None:
+    aligned_R = (adapter.get("rows_per_job")
+                 if adapter is not None else None)
+    if adapter is not None and aligned_R is not None:
+        # SLOT-ALIGNED pool application (training/lora_fusion.py): the
+        # batch's rows are job-contiguous (row block [j*R, (j+1)*R) is
+        # job j — the stack_fleet_batch layout), so there is nothing to
+        # gather: re-lead the stacked pool itself with the layer axis
+        # and apply each job's adapter ONCE per block via
+        # models/lora.aligned_lora_delta. Replaces the per-row gather's
+        # rows_per_job-fold A/B duplication (and its scatter-add
+        # backward) for this layout; ids are not needed — an inactive
+        # slot's zero scaling zeroes its block's delta exactly.
+        if tokens.shape[0] % int(aligned_R):
+            raise ValueError(
+                f"aligned adapter: batch rows {tokens.shape[0]} not a "
+                f"multiple of rows_per_job={aligned_R}")
+        row_blocks = jax.tree_util.tree_map(
+            lambda a: jnp.moveaxis(a, 1, 0), adapter["pool"]["blocks"])
+        row_s = adapter["scaling"]
+    elif adapter is not None:
         # BGMV gather ONCE for the whole batch (the serving-path math,
         # _adapter_rows) — blocks subtree only; the head gathers
         # separately in forward() (gathering the whole pool here would
@@ -552,7 +584,8 @@ def forward_hidden(params: Params, cfg: ModelConfig, tokens: jnp.ndarray, *,
             adp = _block_adp(lb, lora_scaling)
         elif adapter is not None:
             p, lrng, lb = layer
-            adp = _block_adp(lb, row_s)
+            adp = (_aligned_block_adp(lb, row_s, int(aligned_R))
+                   if aligned_R is not None else _block_adp(lb, row_s))
         else:
             p, lrng = layer
             adp = None
@@ -621,6 +654,18 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray, *,
                        deterministic=deterministic, sp_mesh=sp_mesh,
                        sp_inside=sp_inside, lora=lora,
                        lora_scaling=lora_scaling, adapter=adapter)
+    if adapter is not None and adapter.get("rows_per_job") is not None:
+        # slot-aligned head delta: one application per job block (see
+        # forward_hidden); rides in fp32 like every head delta
+        from building_llm_from_scratch_tpu.models.lora import (
+            aligned_lora_delta,
+        )
+
+        head = adapter["pool"]["head"]["weight"]
+        return _head_logits(x, params["head"]["weight"]) + \
+            aligned_lora_delta(
+                x, head["A"], head["B"], adapter["scaling"],
+                int(adapter["rows_per_job"])).astype(jnp.float32)
     if adapter is not None:
         head_rows, head_s = _adapter_rows(
             {"head": adapter["pool"]["head"]}, adapter["scaling"],
@@ -853,6 +898,37 @@ def _new_cache_acc(cache: Params) -> Params:
     return {name: [] for name in cache}
 
 
+def _slot_append_kv(cache: Params, new: Params, l: int,
+                    K: jnp.ndarray, V: jnp.ndarray,
+                    k: jnp.ndarray, v: jnp.ndarray,
+                    lengths: jnp.ndarray):
+    """Per-row append of one layer's fresh k/v (model layout (S, Tq,
+    Hkv, hd)) into the slot cache at each row's offset, quantizing on
+    write under the int8 policy (codes + fp32 scale sidecars). THE one
+    inner write rule shared by ``decode_slots`` (Tq=1) and
+    ``verify_slots`` (Tq=k+1): the speculative path's bit-parity with
+    plain decode depends on these two appends never drifting. Returns
+    the appended (K, V) buffers (also pushed onto ``new``)."""
+    from building_llm_from_scratch_tpu.ops.decode_step import (
+        quantize_kv,
+        slot_cache_append,
+    )
+
+    kt, vt = k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3)
+    if _cache_quantized(cache):
+        kt, ks = quantize_kv(kt)
+        vt, vs = quantize_kv(vt)
+        new["k_scale"].append(slot_cache_append(
+            cache["k_scale"][l], ks, lengths))
+        new["v_scale"].append(slot_cache_append(
+            cache["v_scale"][l], vs, lengths))
+    K = slot_cache_append(K, kt, lengths)
+    V = slot_cache_append(V, vt, lengths)
+    new["k"].append(K)
+    new["v"].append(V)
+    return K, V
+
+
 def _layer_scales(cache: Params, l: int, slot: Optional[jnp.ndarray] = None
                   ) -> dict:
     """``decode_attention`` kwargs for layer ``l``'s scale sidecars
@@ -1060,7 +1136,6 @@ def decode_slots(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         blocks_list = unstack_blocks(params, cfg)
 
     from building_llm_from_scratch_tpu.ops.decode_step import (
-        slot_cache_append,
         supports_shape as _fds_supports,
     )
 
@@ -1089,7 +1164,6 @@ def decode_slots(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     else:
         adp_layers, head_node, head_s = _slot_adapter_layers(adapter, cfg)
 
-    quantized = _cache_quantized(cache)
     new = _new_cache_acc(cache)
     for l, (p, K, V) in enumerate(zip(blocks_list, cache["k"], cache["v"])):
         adp = adp_layers[l] if adp_layers is not None else None
@@ -1106,22 +1180,7 @@ def decode_slots(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             new["k"].append(K)
             new["v"].append(V)
         else:
-            kt, vt = k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3)
-            if quantized:
-                from building_llm_from_scratch_tpu.ops.decode_step import (
-                    quantize_kv,
-                )
-
-                kt, ks = quantize_kv(kt)
-                vt, vs = quantize_kv(vt)
-                new["k_scale"].append(slot_cache_append(
-                    cache["k_scale"][l], ks, lengths))
-                new["v_scale"].append(slot_cache_append(
-                    cache["v_scale"][l], vs, lengths))
-            K = slot_cache_append(K, kt, lengths)
-            V = slot_cache_append(V, vt, lengths)
-            new["k"].append(K)
-            new["v"].append(V)
+            K, V = _slot_append_kv(cache, new, l, K, V, k, v, lengths)
             out = decode_attention(q, K, V, q_positions=positions,
                                    kv_length=lengths + 1,
                                    **_layer_scales(new, l))
@@ -1132,3 +1191,77 @@ def decode_slots(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     x = _norm(cfg, params["final_norm"], x)
     logits = _head_logits(x, params["head"]["weight"], head_node, head_s)
     return logits[:, 0], new
+
+
+def verify_slots(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
+                 lengths: jnp.ndarray, cache: Params,
+                 blocks_list: Optional[list] = None,
+                 adapter: Optional[Params] = None
+                 ) -> Tuple[jnp.ndarray, Params]:
+    """Speculative verify: the Tq = k+1 sibling of ``decode_slots``.
+
+    ``tokens`` (S, Tq) is each slot's last accepted token followed by its
+    k drafted candidates; ``lengths`` (S,) the valid cache prefix per row.
+    ONE forward scores all Tq positions: position j's logits condition on
+    [cache, tokens[:, :j+1]], so they are the model's true next-token
+    distribution exactly when the drafts before j were all accepted — the
+    accept rule (generate.accept_draft_tokens) commits only such
+    prefixes. Appends all Tq candidate k/v panes at per-row offsets (the
+    same ``slot_cache_append`` batched DUS decode uses, quantize-on-write
+    under the int8 policy); the engine advances ``lengths`` by the
+    ACCEPTED count only, so a rejected tail's entries sit past the valid
+    prefix — masked by ``kv_length`` everywhere and overwritten by the
+    next tick's append. No rollback copy exists because none is needed.
+
+    Per-query causality rides the existing ``decode_attention`` per-row
+    masks: query j at absolute position lengths+j attends keys at
+    positions <= lengths+j, i.e. the real prefix plus the drafts before
+    it — never the drafts after it. k is STATIC: every acceptance count
+    0..k+1 flows through this one compiled program, preserving the
+    engine's one-compiled-program invariant.
+
+    Free/mid-prefill slots ride as ignored rows exactly as in
+    ``decode_slots``: their appends land at the row's next write
+    position and are overwritten before anything reads them.
+
+    Returns (fp32 logits (S, Tq, V), updated cache).
+    """
+    rope = _rope_tables(cfg)
+    S, Tq = tokens.shape
+    lengths = lengths.astype(jnp.int32)
+    # position CLAMP: a row near capacity has draft positions past
+    # context_length-1; unclamped they would index past the positional
+    # tables (jnp.take's out-of-bounds fill is NaN) and the NaN v-pane
+    # poisons every query through the value einsum's 0*NaN. Clamped
+    # positions only ever affect TAIL candidates that can never be
+    # committed (prompt + budget <= max_len by admission), so every
+    # committable position keeps its exact positional encoding.
+    positions = jnp.minimum(
+        lengths[:, None] + jnp.arange(Tq)[None, :],
+        cfg.context_length - 1)                                # (S, Tq)
+    x = _embed(cfg, params, tokens, positions, None, True)
+    if blocks_list is None:
+        blocks_list = unstack_blocks(params, cfg)
+
+    # adapter application mirrors decode_slots' gathered path (the pallas
+    # BGMV kernel is single-token-only; a Tq-wide variant is a TPU
+    # follow-up — the XLA gather+einsum is the reference either way)
+    adp_layers, head_node, head_s = _slot_adapter_layers(adapter, cfg)
+
+    new = _new_cache_acc(cache)
+    for l, (p, K, V) in enumerate(zip(blocks_list, cache["k"], cache["v"])):
+        adp = adp_layers[l] if adp_layers is not None else None
+        h = _norm(cfg, p["norm1"], x)
+        q, k, v = _qkv_proj(cfg, p["attn"], h, rope, positions,
+                            adp=adp["attn"] if adp is not None else None)
+        K, V = _slot_append_kv(cache, new, l, K, V, k, v, lengths)
+        out = decode_attention(q, K, V, q_positions=positions,
+                               kv_length=lengths + Tq,
+                               **_layer_scales(new, l))
+        x = x + _attn_out_proj(p["attn"], out, S, Tq,
+                               adp=adp["attn"] if adp is not None else None)
+        x = x + _mlp(cfg, p["mlp"], _norm(cfg, p["norm2"], x),
+                     adp=adp["mlp"] if adp is not None else None)
+    x = _norm(cfg, params["final_norm"], x)
+    logits = _head_logits(x, params["head"]["weight"], head_node, head_s)
+    return logits, new

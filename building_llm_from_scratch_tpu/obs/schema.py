@@ -35,9 +35,12 @@ from typing import Any, Dict, FrozenSet, List
 
 #: Bump when a row type or a load-bearing field changes meaning. The
 #: ``header`` row carries it; consumers key parsing decisions on it.
-SCHEMA_VERSION = 6          # v6: + finetune_job_*/finetune_fleet events
-                            # (fused multi-LoRA training), adapter_save
-                            # grew job_id
+SCHEMA_VERSION = 7          # v7: speculative decoding — `draft` tick
+                            # phase, spec_drafted/spec_accepted on
+                            # request_done + cadence rows, serve_warmup
+                            # grew spec_k/drafter
+                            # (v6: + finetune_job_*/finetune_fleet events,
+                            # adapter_save grew job_id)
 
 #: JSONL row discriminators (the ``type`` field).
 ROW_TYPES = ("header", "metrics", "health", "event", "span")
@@ -47,8 +50,11 @@ ROW_TYPES = ("header", "metrics", "health", "event", "span")
 #: ``tick_<phase>_s`` fields; /metrics exports ``tick_<phase>_seconds``).
 #: ``prefix_copy`` is the KV memory engine's pane traffic (prefix-hit
 #: copies + post-prefill pane extraction, serving/kvcache.py).
-TICK_PHASES = ("admit", "prefix_copy", "prefill", "decode_dispatch",
-               "host_fetch", "sample_commit", "callback_detok")
+#: ``draft`` is the speculative drafter's host-side proposal time
+#: (serving/spec.py; identically 0 on spec-off engines).
+TICK_PHASES = ("admit", "prefix_copy", "prefill", "draft",
+               "decode_dispatch", "host_fetch", "sample_commit",
+               "callback_detok")
 
 #: Trainer StepTimeline segments (``<segment>_s`` fields of training
 #: cadence metrics rows; obs/timeline.py owns the measurement).
@@ -170,8 +176,10 @@ _EVENT_LIST: List[EventSpec] = [
     _spec("request_done", required=("request_id",),
           optional=("n_prompt_tokens", "n_tokens", "finish_reason", "slot",
                     "deadline_s", "queue_wait_s", "ttft_s", "tpot_s",
-                    "e2e_s", "adapter"),
-          doc="one request completed normally (latency summary)"),
+                    "e2e_s", "adapter", "spec_drafted", "spec_accepted"),
+          doc="one request completed normally (latency summary; "
+              "spec_drafted/spec_accepted = this request's speculative "
+              "acceptance ledger on --serve_spec_k engines)"),
     _spec("request_rejected", required=("request_id", "reason"),
           optional=("queue_depth",),
           doc="bounded queue at capacity at submit (HTTP 429)"),
@@ -256,9 +264,12 @@ _EVENT_LIST: List[EventSpec] = [
     _spec("serve_warmup",
           optional=("n_prefill_buckets", "buckets", "seconds", "n_slots",
                     "max_len", "kv_quant", "prefix_cache", "prefill_chunk",
-                    "kv_bytes_per_slot", "prefix_pane_tokens"),
-          doc="prefill programs + decode program compiled; watchers "
-              "frozen; records the KVCachePolicy (quant/chunk/prefix)"),
+                    "kv_bytes_per_slot", "prefix_pane_tokens", "spec_k",
+                    "drafter"),
+          doc="prefill programs + decode (or spec verify) program "
+              "compiled; watchers frozen; records the KVCachePolicy "
+              "(quant/chunk/prefix) and the speculative config "
+              "(spec_k/drafter) when on"),
     _spec("serve_summary", open_fields=True,
           doc="shutdown stats snapshot (histogram percentiles, counters)"),
     _spec("serve_error", required=("error",),

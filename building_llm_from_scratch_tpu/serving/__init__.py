@@ -42,6 +42,10 @@ from building_llm_from_scratch_tpu.serving.request import (
     SamplingParams,
 )
 from building_llm_from_scratch_tpu.serving.scheduler import Scheduler
+from building_llm_from_scratch_tpu.serving.spec import (
+    Drafter,
+    NgramDrafter,
+)
 from building_llm_from_scratch_tpu.serving.supervisor import (
     EngineSupervisor,
     FaultHooks,
@@ -52,10 +56,12 @@ __all__ = [
     "AdapterRegistry",
     "AdapterRegistryFullError",
     "DecodeEngine",
+    "Drafter",
     "EngineDrainingError",
     "EngineSupervisor",
     "FaultHooks",
     "KVCachePolicy",
+    "NgramDrafter",
     "PrefixStore",
     "QueueFullError",
     "Request",

@@ -66,6 +66,7 @@ from building_llm_from_scratch_tpu.models.transformer import (
     prefill_chunk_into_slot,
     prefill_into_slot,
     unstack_blocks,
+    verify_slots,
 )
 from building_llm_from_scratch_tpu.obs.compile import CompileWatcher
 from building_llm_from_scratch_tpu.obs.metrics import (
@@ -136,7 +137,8 @@ class DecodeEngine:
                  restart_backoff_s: float = 0.5,
                  hooks: Optional[FaultHooks] = None,
                  adapters=None,
-                 kv_policy: Optional[KVCachePolicy] = None):
+                 kv_policy: Optional[KVCachePolicy] = None,
+                 spec_k: int = 0, drafter=None):
         import jax
 
         self.cfg = cfg
@@ -168,10 +170,35 @@ class DecodeEngine:
                                             backoff_s=restart_backoff_s)
                            if tick_timeout_s > 0 else None)
 
+        #: speculative decoding (serving/spec.py): k drafted tokens per
+        #: slot per tick, verified by ONE Tq=k+1 compiled program. 0 =
+        #: off (the engine is then byte-for-byte the historical one —
+        #: same programs, same signatures, same cache shapes).
+        self.spec_k = int(spec_k)
+        if self.spec_k < 0:
+            raise ValueError("spec_k must be >= 0")
+        if self.spec_k >= self.max_len:
+            raise ValueError(
+                f"spec_k={self.spec_k} must be < the slot capacity "
+                f"{self.max_len}")
+        self.drafter = None
+        if self.spec_k > 0:
+            from building_llm_from_scratch_tpu.serving.spec import (
+                NgramDrafter,
+            )
+
+            self.drafter = drafter or NgramDrafter()
+        #: cache rows carry ``spec_k`` headroom positions past ``max_len``:
+        #: the verify program appends k+1 candidate entries at the row's
+        #: length, and the LAST legitimate decode position is max_len-1 —
+        #: without headroom the batched DUS would clamp the write start
+        #: and silently overwrite committed KV near capacity
+        self._cache_len = self.max_len + self.spec_k
+
         self.queue = RequestQueue(max_queue)
         self.scheduler = Scheduler(self.n_slots)
         self.cache = init_slot_cache(
-            cfg, self.n_slots, self.max_len,
+            cfg, self.n_slots, self._cache_len,
             policy=self.kv_policy)                      # guarded-by: _lock
         self._blocks = unstack_blocks(params, cfg)
         #: chunked-prefill progress per slot (slot -> host dict); a slot
@@ -211,6 +238,11 @@ class DecodeEngine:
         self._topks = np.zeros((S,), np.int32)          # guarded-by: _lock
         # per-slot adapter pool row; −1 = base model (exact zero delta)
         self._adapter_ids = np.full((S,), -1, np.int32)  # guarded-by: _lock
+        # per-slot committed-token history (prompt + generated), the
+        # n-gram drafter's haystack; host-only, maintained iff spec is on
+        self._hist = (np.zeros((S, self.max_len), np.int32)
+                      if self.spec_k else None)          # guarded-by: _lock
+        self._hist_len = np.zeros((S,), np.int32)        # guarded-by: _lock
         # per-adapter request accounting ("base" for un-adapted traffic):
         # name -> {finished, failed, tokens} — feeds the labeled /metrics
         # series and serve_summary
@@ -233,7 +265,13 @@ class DecodeEngine:
         copy_jit = jax.jit(self._copy_impl, donate_argnums=(0,))
         extract_jit = jax.jit(functools.partial(
             extract_prefix_panes, pane_len=self._prefix_pane_len))
-        decode_jit = jax.jit(self._decode_impl, donate_argnums=(0,))
+        # spec on: the Tq=k+1 verify program IS the tick program — the
+        # plain decode step is never built (every slot, spec-opted-out
+        # rows included, rides verify; their commit count is clamped to 1
+        # on the host). spec off: the historical decode step, untouched.
+        step_jit = jax.jit(self._verify_impl if self.spec_k
+                           else self._decode_impl, donate_argnums=(0,))
+        step_label = "serve_verify" if self.spec_k else "serve_decode"
         if watch_compiles:
             self._prefill = CompileWatcher(prefill_jit,
                                            label="serve_prefill",
@@ -245,14 +283,20 @@ class DecodeEngine:
             self._prefix_extract = CompileWatcher(
                 extract_jit, label="serve_prefix_extract",
                 multi_program=True)
-            self._decode = CompileWatcher(decode_jit, label="serve_decode",
+            step_watched = CompileWatcher(step_jit, label=step_label,
                                           multi_program=True)
         else:
             self._prefill = prefill_jit
             self._prefill_chunk = chunk_jit
             self._prefix_copy = copy_jit
             self._prefix_extract = extract_jit
-            self._decode = decode_jit
+            step_watched = step_jit
+        if self.spec_k:
+            self._verify = step_watched
+            self._decode = None
+        else:
+            self._decode = step_watched
+            self._verify = None
 
         self._lock = threading.RLock()
         self._work = threading.Condition()
@@ -326,6 +370,15 @@ class DecodeEngine:
         self._window_prefix_hits = 0                     # guarded-by: _lock
         self._window_prefix_misses = 0                   # guarded-by: _lock
         self._tick_pf0 = 0.0                             # guarded-by: _lock
+        # speculative-decoding accounting: drafted = k per spec-enabled
+        # decoding row per tick; accepted = the in-graph n_acc (draft
+        # tokens the verify committed). Cumulative totals feed /metrics
+        # and the acceptance-ratio gauge; window counters drain into the
+        # cadence metrics row
+        self.spec_tokens_drafted = 0                     # guarded-by: _lock
+        self.spec_tokens_accepted = 0                    # guarded-by: _lock
+        self._window_spec_drafted = 0                    # guarded-by: _lock
+        self._window_spec_accepted = 0                   # guarded-by: _lock
 
     # -- jitted programs (close over params/cfg/blocks so per-tick call
     # signatures carry only the small mutable state + caches) -------------
@@ -401,6 +454,38 @@ class DecodeEngine:
         # retires just that slot (reason non_finite_logits)
         ok = jnp.all(jnp.isfinite(logits), axis=-1)
         return nxt, ok, cache
+
+    def _verify_impl(self, cache, tokens, lengths, base_keys,
+                     n_gen, temps, topks, pool=None, pool_scale=None,
+                     adapter_ids=None):
+        """Speculative tick: ONE Tq=k+1 forward scores every slot's
+        [last_token, d_1..d_k] and the in-graph accept rule commits the
+        longest valid prefix. Position j of row s samples with the
+        fold-in key for token index n_gen[s]+j — the exact key the
+        non-speculative path would use for that token — so committed
+        tokens are bit-identical to spec-off at any acceptance rate.
+        Returns (tokens (S, k+1), n_accepted (S,), ok (S,), cache)."""
+        import jax
+        import jax.numpy as jnp
+
+        from building_llm_from_scratch_tpu.generate import (
+            accept_draft_tokens,
+        )
+
+        adapter = None
+        if pool is not None:
+            adapter = {"pool": pool, "scaling": pool_scale,
+                       "ids": adapter_ids}
+        logits, cache = verify_slots(
+            self.params, self.cfg, tokens, lengths, cache, self._blocks,
+            adapter=adapter)
+        Tq = tokens.shape[1]
+        offsets = n_gen[:, None] + jnp.arange(Tq)[None, :]     # (S, Tq)
+        keys = jax.vmap(jax.vmap(token_rng, in_axes=(None, 0)))(
+            base_keys, offsets)
+        toks, n_acc, ok = accept_draft_tokens(
+            logits, tokens[:, 1:], keys, temps, topks, self.max_top_k)
+        return toks, n_acc, ok, cache
 
     def _pool_args(self) -> tuple:
         """Positional tail for the compiled programs: the registry's
@@ -793,6 +878,9 @@ class DecodeEngine:
         self._temps[slot] = temp
         self._topks[slot] = topk
         self._adapter_ids[slot] = adapter_row
+        if self._hist is not None:
+            self._hist[slot, :Tp] = req.prompt_ids
+            self._hist_len[slot] = Tp
         if self.hooks.poison_nan(req):
             self._poison_slot_cache(slot)      # fault injection (tests)
         # explicit fetch; blocks until prefill ran
@@ -857,6 +945,9 @@ class DecodeEngine:
         self._temps[slot] = temp
         self._topks[slot] = topk
         self._adapter_ids[slot] = adapter_row
+        if self._hist is not None:
+            self._hist[slot, :Tp] = req.prompt_ids
+            self._hist_len[slot] = Tp
         self._prefill_state[slot] = {
             "req": req, "pos": pos, "Tp": Tp, "base_key": base_key,
             "temp": temp, "topk": topk, "adapter_row": adapter_row,
@@ -1152,6 +1243,10 @@ class DecodeEngine:
                 self._book_tick_wall(t_tick0)
                 self._maybe_log_metrics()
                 return True
+            if self.spec_k:
+                # speculative tick: draft k per slot, ONE verify forward,
+                # multi-token commit (serving/spec.py + _verify_tick)
+                return self._verify_tick(decoding, gen, t_tick0)
             t_dec = time.perf_counter()
             nxt, ok, cache = self._decode(
                 self.cache, self._last_tokens,
@@ -1206,6 +1301,98 @@ class DecodeEngine:
             pass
 
     # holds: _lock
+    def _verify_tick(self, decoding, gen: int, t_tick0: float) -> bool:
+        """One speculative tick: propose k drafts per decoding slot
+        (host-side, ``drafter.propose`` against the slot's own history),
+        run THE one compiled verify program over all slots, and commit
+        each row's longest-accepted prefix — 1..k+1 tokens per slot per
+        tick, every count through the same program signature (zero
+        recompiles across acceptance churn, watcher-enforced).
+
+        Rows whose request opted out (``SamplingParams.spec=False``)
+        ride the same program with their commit clamped to one token —
+        per-request semantics cost no extra programs. Mid-prefill slots
+        were already filtered out of ``decoding`` by the caller and ride
+        as ignored rows inside the program, exactly as in the plain
+        decode tick. Returns False on a generation abort (tick wall
+        already booked), mirroring ``step()``'s decode block."""
+        import jax
+
+        k = self.spec_k
+        t_draft = time.perf_counter()
+        drafts = np.zeros((self.n_slots, k), np.int32)
+        for slot, req in decoding:
+            if req.params.spec:
+                n_hist = self._hist_len[slot]
+                drafts[slot] = self.drafter.propose(
+                    self._hist[slot, :n_hist], k)
+        tokens_in = np.concatenate(
+            [self._last_tokens[:, None], drafts], axis=1)
+        self._tick_add("draft", time.perf_counter() - t_draft)
+        t_dec = time.perf_counter()
+        toks, n_acc, ok, cache = self._verify(
+            self.cache, tokens_in, self._lengths, self._base_keys,
+            self._n_gen, self._temps, self._topks,
+            *(self._pool_args() + (self._adapter_ids,)
+              if self.adapters is not None else ()))
+        self._tick_add("decode_dispatch", time.perf_counter() - t_dec)
+        if self._generation != gen:
+            self._book_tick_wall(t_tick0)
+            return False
+        # ONE explicit fetch for the tick's three results (+ the donated
+        # cache rebind) — the same sanctioned d->h discipline as the
+        # plain decode tick
+        t_fetch = time.perf_counter()
+        self.cache = cache
+        toks, n_acc, ok_rows = jax.device_get((toks, n_acc, ok))
+        self._tick_add("host_fetch", time.perf_counter() - t_fetch)
+        cb0 = self._tick_acc["callback_detok"]
+        t_commit = time.perf_counter()
+        for slot, req in decoding:
+            if self._generation != gen:
+                self._book_tick_wall(t_tick0)
+                return False
+            if not bool(ok_rows[slot]):
+                self._fail_request(
+                    slot, req,
+                    f"non-finite logits at token {len(req.output_ids)}",
+                    reason="non_finite_logits")
+                continue
+            is_spec = req.params.spec
+            n_commit = 1 + (int(n_acc[slot]) if is_spec else 0)
+            if is_spec:
+                # acceptance telemetry counts the IN-GRAPH decision
+                # (drafter quality), independent of host truncation at
+                # eos/budget below
+                accepted = int(n_acc[slot])
+                req.spec_drafted += k
+                req.spec_accepted += accepted
+                self.spec_tokens_drafted += k
+                self.spec_tokens_accepted += accepted
+                self._window_spec_drafted += k
+                self._window_spec_accepted += accepted
+            for j in range(n_commit):
+                # each commit advances the row's valid-KV prefix by one:
+                # position j's entry was appended by THIS tick's verify
+                # (the trailing rejected entries stay past the prefix,
+                # masked everywhere and overwritten next tick)
+                self._lengths[slot] += 1
+                self._accept_token(slot, req, int(toks[slot, j]), gen)
+                if self._generation != gen:
+                    self._book_tick_wall(t_tick0)
+                    return False
+                if req.done:
+                    break               # eos/budget/fault: slot already freed
+        self._tick_add("sample_commit", max(
+            time.perf_counter() - t_commit
+            - (self._tick_acc["callback_detok"] - cb0), 0.0))
+        self.n_ticks += 1
+        self._window_ticks += 1
+        self._book_tick_wall(t_tick0)
+        self._maybe_log_metrics()
+        return True
+
+    # holds: _lock
     def _accept_token(self, slot: int, req: Request, tok: int,
                       gen: int) -> None:
         eos = resolve_eos(req.params, self.cfg.eos_id)
@@ -1220,6 +1407,11 @@ class DecodeEngine:
         req.output_ids.append(tok)
         self._last_tokens[slot] = tok
         self._n_gen[slot] = len(req.output_ids)
+        if self._hist is not None:
+            # committed token enters the drafter's haystack (the dropped
+            # eos above never does — it is not part of the sequence)
+            self._hist[slot, self._hist_len[slot]] = tok
+            self._hist_len[slot] += 1
         self.tokens_generated += 1
         self._window_tokens += 1
         t_cb = time.perf_counter()
@@ -1286,6 +1478,7 @@ class DecodeEngine:
         self._temps[slot] = 0.0
         self._topks[slot] = 0
         self._adapter_ids[slot] = -1
+        self._hist_len[slot] = 0
 
     # holds: _lock
     def _count_adapter(self, req: Request, outcome: str) -> None:
@@ -1387,6 +1580,9 @@ class DecodeEngine:
         if self.prefix_store is not None:
             kv["prefix_hits"] = self._window_prefix_hits
             kv["prefix_misses"] = self._window_prefix_misses
+        if self.spec_k:
+            kv["spec_drafted"] = self._window_spec_drafted
+            kv["spec_accepted"] = self._window_spec_accepted
         sink.log_metrics(self.n_ticks,
                          serve_tok_s=round(self._window_tokens / dt, 2),
                          requests_finished=self.requests_finished,
@@ -1403,6 +1599,8 @@ class DecodeEngine:
         self._window_prefill_chunks = 0
         self._window_prefix_hits = 0
         self._window_prefix_misses = 0
+        self._window_spec_drafted = 0
+        self._window_spec_accepted = 0
         self._tick_acc = {ph: 0.0 for ph in TICK_PHASES}
         self._tick_acc_total = 0.0
 
@@ -1454,11 +1652,25 @@ class DecodeEngine:
                         np.int32(0), zero_key, np.float32(0.0),
                         np.int32(0), *self._pool_args_for(np.int32(-1)))
                     self.cache = cache
-            nxt, _ok, cache = self._decode(
-                self.cache, self._last_tokens,
-                self._lengths, self._base_keys, self._n_gen, self._temps,
-                self._topks, *(self._pool_args() + (self._adapter_ids,)
-                               if self.adapters is not None else ()))
+            if self.spec_k:
+                # the Tq=k+1 verify program IS the tick program when
+                # speculation is on — warm (and freeze) it instead of a
+                # plain decode step that would never run
+                warm_tokens = np.zeros((self.n_slots, self.spec_k + 1),
+                                       np.int32)
+                nxt, _n_acc, _ok, cache = self._verify(
+                    self.cache, warm_tokens, self._lengths,
+                    self._base_keys, self._n_gen, self._temps,
+                    self._topks, *(self._pool_args()
+                                   + (self._adapter_ids,)
+                                   if self.adapters is not None else ()))
+            else:
+                nxt, _ok, cache = self._decode(
+                    self.cache, self._last_tokens,
+                    self._lengths, self._base_keys, self._n_gen,
+                    self._temps, self._topks,
+                    *(self._pool_args() + (self._adapter_ids,)
+                      if self.adapters is not None else ()))
             self.cache = cache
             jax.device_get(nxt)               # block until compiled + ran
             if isinstance(self._prefill, CompileWatcher):
@@ -1474,7 +1686,10 @@ class DecodeEngine:
             self._win_t0_wall = time.time()
             self._window_tokens = 0
             self.warmed_up = True
-        bps = self.kv_policy.bytes_per_slot(self.cfg, self.max_len)
+        bps = self.kv_policy.bytes_per_slot(self.cfg, self._cache_len)
+        spec_fields = ({"spec_k": self.spec_k,
+                        "drafter": self.drafter.describe()}
+                       if self.spec_k else {})
         get_metrics().event(
             "serve_warmup", n_prefill_buckets=len(buckets),
             buckets=buckets, seconds=round(time.monotonic() - t0, 3),
@@ -1483,21 +1698,23 @@ class DecodeEngine:
             prefix_pane_tokens=(self._prefix_pane_len
                                 if self.prefix_store is not None
                                 else None),
-            **self.kv_policy.describe())
+            **self.kv_policy.describe(), **spec_fields)
         logger.info(
-            "Serving warmup: %s + 1 decode program in %.2fs (kv %s, "
-            "%.2f MiB/slot%s)",
+            "Serving warmup: %s + 1 %s program in %.2fs (kv %s, "
+            "%.2f MiB/slot%s%s)",
             (f"1 chunk program (C={self.kv_policy.prefill_chunk})"
              if self.kv_policy.prefill_chunk > 0
              else f"{len(buckets)} prefill buckets {buckets}"),
+            f"verify (k={self.spec_k})" if self.spec_k else "decode",
             time.monotonic() - t0, self.kv_policy.kv_quant,
             bps["total_bytes"] / 1024 ** 2,
-            ", prefix cache on" if self.prefix_store is not None else "")
+            ", prefix cache on" if self.prefix_store is not None else "",
+            f", spec {self.drafter.describe()}" if self.spec_k else "")
 
     def _watchers(self) -> list:
         return [w for w in (self._prefill, self._prefill_chunk,
                             self._prefix_copy, self._prefix_extract,
-                            self._decode)
+                            self._decode, self._verify)
                 if isinstance(w, CompileWatcher)]
 
     @property
@@ -1596,6 +1813,7 @@ class DecodeEngine:
                 self._temps[:] = 0.0
                 self._topks[:] = 0
                 self._adapter_ids[:] = -1
+                self._hist_len[:] = 0
                 self._prefill_state.clear()
                 # the old cache may be donation-poisoned or numerically
                 # corrupt; a fresh one has identical shapes/dtypes, so the
@@ -1603,7 +1821,7 @@ class DecodeEngine:
                 # The prefix store survives: its panes are independent
                 # device arrays a wedged tick can't have corrupted.
                 self.cache = init_slot_cache(self.cfg, self.n_slots,
-                                             self.max_len,
+                                             self._cache_len,
                                              policy=self.kv_policy)
             backoff = self.restart_backoff_s * (2.0 ** (n_restart - 1))
             get_metrics().event(
@@ -1840,6 +2058,14 @@ class DecodeEngine:
                 "n_restarts": self.n_restarts,
                 "draining": self._draining,
             }
+            if self.spec_k:
+                out["spec_k"] = self.spec_k
+                out["spec_tokens_drafted"] = self.spec_tokens_drafted
+                out["spec_tokens_accepted"] = self.spec_tokens_accepted
+                if self.spec_tokens_drafted:
+                    out["spec_acceptance_ratio"] = round(
+                        self.spec_tokens_accepted
+                        / self.spec_tokens_drafted, 6)
             if self._adapter_counts:
                 out["per_adapter"] = {
                     nm: dict(c)
@@ -1898,6 +2124,10 @@ class DecodeEngine:
                 counters["prefix_evictions"] = \
                     self.prefix_store.n_evictions
                 counters["prefix_inserts"] = self.prefix_store.n_inserts
+            if self.spec_k:
+                counters["spec_tokens_drafted"] = self.spec_tokens_drafted
+                counters["spec_tokens_accepted"] = \
+                    self.spec_tokens_accepted
             # per-adapter labeled series (multi-tenant accounting): one
             # requests/tokens counter triple per adapter name seen, plus
             # a live per-adapter slot-occupancy gauge
@@ -1929,7 +2159,15 @@ class DecodeEngine:
             # sizes n_slots (the int8 policy's whole point); the
             # hit-ratio is the prefix cache's scoreboard
             gauges["kv_bytes_per_slot"] = self.kv_policy.bytes_per_slot(
-                self.cfg, self.max_len)["total_bytes"]
+                self.cfg, self._cache_len)["total_bytes"]
+            if self.spec_k:
+                # acceptance ratio is THE drafter-quality dial: low ratio
+                # means the verify widths are wasted compute — shrink k
+                # or disable spec for the workload (README guidance)
+                gauges["spec_k"] = self.spec_k
+                gauges["spec_acceptance_ratio"] = round(
+                    self.spec_tokens_accepted
+                    / max(self.spec_tokens_drafted, 1), 6)
             if self.prefix_store is not None:
                 ratio = self.prefix_store.hit_ratio()
                 gauges["prefix_hit_ratio"] = (round(ratio, 6)

@@ -95,6 +95,9 @@ def params_from_record(rec: dict, default_max_new: int) -> SamplingParams:
         # (ValueError -> HTTP 400)
         adapter=(str(rec["adapter"])
                  if rec.get("adapter") is not None else None),
+        # per-request speculative opt-out ("spec": false) — tokens are
+        # bit-identical either way; this only trades draft compute
+        spec=bool(rec.get("spec", True)),
     )
 
 
@@ -403,6 +406,7 @@ def run_serve(args, comps, metric_logger) -> DecodeEngine:
         metrics_every=args.serve_metrics_every,
         adapters=adapters,
         kv_policy=kv_policy,
+        spec_k=getattr(args, "serve_spec_k", 0),
     )
     stall = None
     if args.stall_timeout > 0 and engine.supervisor is None:

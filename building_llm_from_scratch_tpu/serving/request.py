@@ -63,6 +63,13 @@ class SamplingParams:
     adapter's delta, co-batched with any other adapters' traffic in the
     same compiled program. ``None`` = the base model. Unknown names are
     rejected at submit (HTTP 400).
+
+    ``spec`` opts this request out of speculative decoding
+    (``--serve_spec_k`` engines) when False: its rows commit exactly one
+    token per tick. Tokens are bit-identical either way (the accept rule
+    is exact) — the opt-out exists for workloads whose acceptance rate is
+    too low to be worth the drafting, e.g. high-entropy sampling. No-op
+    on spec-off engines.
     """
 
     max_new_tokens: int = 128
@@ -73,6 +80,7 @@ class SamplingParams:
     ignore_eos: bool = False
     deadline_s: Optional[float] = None
     adapter: Optional[str] = None
+    spec: bool = True
 
 
 class Request:
@@ -93,6 +101,10 @@ class Request:
         self.slot: Optional[int] = None
         self.error: Optional[str] = None
         self._cancelled = False  # client gave up; retired at next boundary
+        # speculative-decoding ledger (spec engines only): drafted = k per
+        # decode tick; accepted = the in-graph accepted-draft count
+        self.spec_drafted = 0
+        self.spec_accepted = 0
         # timestamps (time.monotonic): submit -> admit (queue wait) ->
         # first token (TTFT) -> finish (TPOT over the decode tail).
         # wall_submit anchors the monotonic timeline to unix time so the
@@ -191,6 +203,11 @@ class Request:
             out["deadline_s"] = self.params.deadline_s
         if self.params.adapter is not None:
             out["adapter"] = self.params.adapter
+        if self.spec_drafted:
+            # acceptance telemetry (ISSUE 14): how much of this request's
+            # decode the drafter paid for
+            out["spec_drafted"] = self.spec_drafted
+            out["spec_accepted"] = self.spec_accepted
         for name, fn in (("queue_wait_s", self.queue_wait_s),
                          ("ttft_s", self.ttft_s), ("tpot_s", self.tpot_s),
                          ("e2e_s", self.e2e_s)):

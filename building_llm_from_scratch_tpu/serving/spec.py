@@ -1,0 +1,111 @@
+"""Speculative-decoding drafters for the serving engine.
+
+TPOT — the per-token decode latency — is one full forward pass per tick,
+the one axis continuous batching cannot attack. Speculative decoding
+breaks it: a cheap DRAFTER proposes k candidate tokens per slot per
+tick and ONE compiled verify program (``models/transformer.verify_slots``,
+a Tq=k+1 sibling of the decode step) scores all k+1 positions in a
+single forward; the engine commits the longest valid prefix
+(``generate.accept_draft_tokens`` — exact-match acceptance, which for
+the point-mass drafts below IS Leviathan rejection sampling and keeps
+engine tokens bit-identical to the spec-off path). k is static, the
+drafts and the accepted counts are DATA — the engine keeps its
+one-compiled-program invariant at any acceptance rate.
+
+This module is the drafting side. The first drafter is PROMPT-LOOKUP /
+n-gram drafting (Saxena's prompt-lookup decoding; the self-history
+variant): suffix-match the slot's last n committed tokens against its
+OWN token history (prompt + generated) and propose the continuation of
+the most recent earlier occurrence. Zero extra model, zero extra HBM,
+pure host-side numpy on arrays the engine already keeps — and extremely
+effective exactly where decode latency hurts most (templated prompts,
+extraction/summarization over a context, code, any self-repetitive
+generation).
+
+The ``Drafter`` interface is deliberately tiny so a model-based drafter
+(a small GPT-2 proposing for a large target) can slot in later: one
+``propose(history, k) -> (k,) int32`` per active slot per tick. A draft
+is a POINT MASS — the accept rule relies on that (see
+``accept_draft_tokens``); a future distribution-emitting drafter would
+extend the accept rule, not this interface.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["Drafter", "NgramDrafter"]
+
+
+class Drafter:
+    """Drafter interface: propose k candidate next tokens for one slot.
+
+    ``history`` is the slot's committed token ids (prompt + generated so
+    far, most recent last) as a 1-D int32 numpy array — host state the
+    engine already tracks; ``propose`` must be pure host compute (it runs
+    inside the engine tick, registered as a GL01x hot path: a device
+    sync here would stall every co-resident slot).
+
+    Must return exactly ``k`` int32 token ids. There is no "no draft"
+    return: with a static verify width, a low-confidence draft costs
+    nothing extra to verify and simply gets rejected — propose the best
+    guess available (the base class repeats the last token, a fixed
+    point of greedy decode loops).
+    """
+
+    def propose(self, history: np.ndarray, k: int) -> np.ndarray:
+        return np.full((k,), history[-1], np.int32)
+
+    def describe(self) -> str:
+        return type(self).__name__
+
+
+class NgramDrafter(Drafter):
+    """Prompt-lookup / n-gram drafting against the slot's own history.
+
+    For n from ``max_n`` down to ``min_n``: take the history's last n
+    tokens as the query, find the MOST RECENT earlier occurrence of that
+    n-gram, and propose the k tokens that followed it. Longer matches
+    are tried first (more context = better continuation); the first hit
+    wins. No occurrence at any n falls back to repeating the last token
+    (``Drafter.propose``) — still a valid point-mass draft, and the
+    fixed point greedy decode converges to anyway.
+
+    The scan is one vectorized sliding-window comparison per n
+    (O(len(history) * n) numpy ops, no Python loop over positions), so a
+    full slot batch drafts in well under the cost of one model forward.
+    """
+
+    def __init__(self, max_n: int = 3, min_n: int = 1):
+        if min_n < 1 or max_n < min_n:
+            raise ValueError(
+                f"need 1 <= min_n <= max_n, got min_n={min_n} "
+                f"max_n={max_n}")
+        self.max_n = int(max_n)
+        self.min_n = int(min_n)
+
+    def propose(self, history: np.ndarray, k: int) -> np.ndarray:
+        L = history.shape[0]
+        for n in range(self.max_n, self.min_n - 1, -1):
+            if L < n + 1:
+                continue            # history too short to match AND continue
+            suffix = history[L - n:]
+            # windows[s] = history[s : s+n]; candidate starts s < L-n (the
+            # window at L-n is the query suffix itself) — every candidate
+            # therefore has >= 1 continuation token at s+n
+            windows = np.lib.stride_tricks.sliding_window_view(history, n)
+            hits = np.flatnonzero(
+                (windows[: L - n] == suffix).all(axis=1))
+            if hits.size == 0:
+                continue
+            s = hits[-1]            # most recent occurrence wins
+            cont = history[s + n: s + n + k]
+            if cont.shape[0] < k:   # ran off the end: pad with last token
+                cont = np.concatenate(
+                    [cont, np.full((k - cont.shape[0],), cont[-1],
+                                   history.dtype)])
+            return cont.astype(np.int32, copy=False)
+        return super().propose(history, k)
+
+    def describe(self) -> str:
+        return f"ngram(max_n={self.max_n},min_n={self.min_n})"

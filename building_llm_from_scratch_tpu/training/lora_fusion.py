@@ -202,7 +202,8 @@ def make_fused_train_step(cfg: ModelConfig, *, capacity: int,
                           grad_clip_norm: float = 1.0,
                           b1: float = 0.9, b2: float = 0.999,
                           eps: float = 1e-8,
-                          jit: bool = True) -> Callable:
+                          jit: bool = True,
+                          aligned: bool = True) -> Callable:
     """Build ``fused_step(state, batch) -> (state, metrics)``.
 
     ``batch``: ``inputs``/``targets``/``weights`` (B, T) stacked across
@@ -219,7 +220,16 @@ def make_fused_train_step(cfg: ModelConfig, *, capacity: int,
     correction uses per-job counts, and a job whose loss or gradient
     norm is non-finite keeps its params/moments/count untouched this
     step (the in-graph half of fault isolation; the host retires it at
-    the next flush)."""
+    the next flush).
+
+    ``aligned`` (default): apply each job's adapter ONCE against its
+    contiguous row block via the ``(J, R*T)`` reshape
+    (``models/lora.aligned_lora_delta``) — the fused batch is always
+    slot-aligned (``stack_fleet_batch`` is THE constructor), so the
+    per-row gather's rows_per_job-fold A/B duplication (and its
+    scatter-add backward) buys nothing here. ``aligned=False`` keeps
+    the historical gather path (the serving-engine math; the k=3
+    aligned-vs-gather parity test pins the two equal)."""
     J = int(capacity)
 
     def bcast(vec: jnp.ndarray, leaf: jnp.ndarray) -> jnp.ndarray:
@@ -235,7 +245,13 @@ def make_fused_train_step(cfg: ModelConfig, *, capacity: int,
                             0.0)
 
         def loss_fn(trainable):
-            adapter = {"pool": trainable, "scaling": scaling, "ids": ids}
+            if aligned:
+                rows_per_job = batch["inputs"].shape[0] // J
+                adapter = {"pool": trainable, "scaling": scaling,
+                           "rows_per_job": rows_per_job}
+            else:
+                adapter = {"pool": trainable, "scaling": scaling,
+                           "ids": ids}
             logits = forward(state["frozen"], cfg, batch["inputs"],
                              rng=step_rng,
                              deterministic=(cfg.drop_rate <= 0.0),
@@ -383,6 +399,21 @@ class FinetuneJob:
     def total_steps(self) -> int:
         return self.steps_per_epoch * self.n_epochs
 
+    def fast_forward(self, steps_done: int) -> None:
+        """Resume positioning: place the batch iterator exactly where a
+        job that has consumed ``steps_done`` batches stands — epoch
+        ``steps_done // steps_per_epoch``, ``steps_done %
+        steps_per_epoch`` batches into it. Batches are a pure function
+        of (seed, epoch, index), so the post-resume row sequence is
+        bit-identical to the uninterrupted run's (the same cursor
+        discipline the PR 1 trainer resume uses)."""
+        self.steps_done = int(steps_done)
+        self._epoch = self.steps_done // max(self.steps_per_epoch, 1)
+        skip = self.steps_done % max(self.steps_per_epoch, 1)
+        self._iter = iter(self.make_batches(self._epoch))
+        for _ in range(skip):
+            next(self._iter)
+
     def next_rows(self):
         """The job's next ``rows_per_step`` collated rows, cycling epochs
         (each epoch reshuffles deterministically in (seed, epoch)).
@@ -492,7 +523,9 @@ class FusedLoRATrainer:
                  weight_decay: float = 0.1, grad_clip_norm: float = 1.0,
                  seed: int = 123, log_every: int = 10,
                  export_dir: Optional[str] = None,
-                 deploy=None, compile_telemetry: bool = True):
+                 deploy=None, compile_telemetry: bool = True,
+                 ckpt_dir: Optional[str] = None, save_every: int = 0,
+                 keep_ckpts: int = 0, aligned: bool = True):
         if capacity < 1:
             raise ValueError("capacity must be >= 1")
         if rank < 1:
@@ -513,6 +546,12 @@ class FusedLoRATrainer:
         self.global_step = 0
         self.tokens_seen = 0
         self.preempted = False
+        #: fleet checkpoint/resume (the PR 1 machinery applied to the
+        #: stacked pool state — it is a plain pytree): model_pg_<step>
+        #: dirs under ckpt_dir, manifest-validated, retention-GC'd
+        self.ckpt_dir = ckpt_dir
+        self.save_every = int(save_every)
+        self.keep_ckpts = int(keep_ckpts)
         self._pending_jobs: collections.deque = collections.deque()
         self._slots: List[Optional[FinetuneJob]] = [None] * self.capacity
         self._pending_metrics: List = []
@@ -526,7 +565,7 @@ class FusedLoRATrainer:
             cfg, capacity=self.capacity, peak_lr=peak_lr,
             initial_lr=initial_lr, min_lr=min_lr,
             warmup_steps=warmup_steps, weight_decay=weight_decay,
-            grad_clip_norm=grad_clip_norm)
+            grad_clip_norm=grad_clip_norm, aligned=aligned)
         self._watcher: Optional[CompileWatcher] = None
         if compile_telemetry:
             self._watcher = CompileWatcher(self._step_fn,
@@ -645,6 +684,126 @@ class FusedLoRATrainer:
             self.state["trainable"])
         self._zero_slot_opt(slot)
 
+    # -- checkpoint / resume -----------------------------------------------
+    #
+    # The stacked pool/optimizer state is a plain pytree, so the PR 1
+    # checkpoint machinery applies directly: sharded manifest writes
+    # (per-shard bytes+sha256), `--resume auto` latest-valid discovery,
+    # retention GC. The host-side fleet state (per-job cursors, slot
+    # assignments, admission counter) rides the manifest metadata; job
+    # batches are a pure function of (seed, epoch, index), so a resumed
+    # fleet's per-job loss trajectories continue bit-for-bit
+    # (test-pinned, incl. across a real SIGTERM).
+
+    def _ckpt_metadata(self) -> Dict[str, Any]:
+        return {
+            "global_step": self.global_step,
+            "fleet": True,
+            "tokens_seen": self.tokens_seen,
+            "n_admitted": self._n_admitted,
+            "capacity": self.capacity,
+            "rank": self.rank,
+            "slots": [j.name if j is not None else None
+                      for j in self._slots],
+            "pending": [j.name for j in self._pending_jobs],
+            "jobs": {j.name: {
+                "status": j.status, "steps_done": j.steps_done,
+                "supervised_tokens": j.supervised_tokens,
+                "final_loss": j.final_loss, "artifact": j.artifact,
+                "error": j.error} for j in self.jobs},
+        }
+
+    def save_checkpoint(self) -> Optional[str]:
+        """Write one step-tagged fleet checkpoint (no-op without
+        ``ckpt_dir``). Called only at flush boundaries, so no posted
+        metric DMAs straddle the save and the job ledgers in the
+        metadata are consistent with ``global_step``."""
+        if not self.ckpt_dir:
+            return None
+        from building_llm_from_scratch_tpu.training.checkpoint import (
+            save_checkpoint,
+        )
+        from building_llm_from_scratch_tpu.training.resilience import (
+            prune_checkpoints,
+        )
+
+        path = os.path.join(self.ckpt_dir,
+                            f"model_pg_{self.global_step}")
+        save_checkpoint(path, self.state,
+                        extra_metadata=self._ckpt_metadata())
+        if self.keep_ckpts > 0:
+            prune_checkpoints(self.ckpt_dir, self.keep_ckpts)
+        return path
+
+    def restore(self, ckpt_path: str) -> "FusedLoRATrainer":
+        """Resume from a fleet checkpoint: device state restores through
+        ``load_checkpoint`` (manifest-validated), host job state maps
+        back by NAME onto the jobs already added via ``add_job`` —
+        running jobs re-enter their slots with their batch cursors
+        fast-forwarded, finished/failed jobs stay retired, the pending
+        queue keeps its order. Jobs added but absent from the
+        checkpoint queue as NEW pending tenants (hot-join on a freed
+        slot, the fleet's normal admission)."""
+        from building_llm_from_scratch_tpu.training.checkpoint import (
+            checkpoint_metadata,
+            load_checkpoint,
+        )
+
+        meta = checkpoint_metadata(ckpt_path)
+        if not meta.get("fleet"):
+            raise ValueError(
+                f"{ckpt_path} is not a fleet checkpoint (trainer "
+                "checkpoints don't restore into FusedLoRATrainer)")
+        if (int(meta.get("capacity", -1)) != self.capacity
+                or int(meta.get("rank", -1)) != self.rank):
+            raise ValueError(
+                f"{ckpt_path}: checkpoint capacity/rank "
+                f"({meta.get('capacity')}/{meta.get('rank')}) does not "
+                f"match this fleet ({self.capacity}/{self.rank})")
+        self.state = load_checkpoint(ckpt_path, self.state)
+        self.global_step = int(meta.get("global_step", 0))
+        self.tokens_seen = int(meta.get("tokens_seen", 0))
+        self._n_admitted = int(meta.get("n_admitted", 0))
+        by_name = {j.name: j for j in self.jobs}
+        job_meta = meta.get("jobs", {})
+        for name, jm in job_meta.items():
+            job = by_name.get(name)
+            if job is None:
+                logger.warning(
+                    "Fleet resume: checkpoint job '%s' (%s) was not "
+                    "re-added; its pool row resumes untrained-on.",
+                    name, jm.get("status"))
+                continue
+            job.status = jm.get("status", "pending")
+            job.supervised_tokens = float(
+                jm.get("supervised_tokens", 0.0))
+            job.final_loss = jm.get("final_loss")
+            job.artifact = jm.get("artifact")
+            job.error = jm.get("error")
+            job.fast_forward(int(jm.get("steps_done", 0)))
+        # rebuild the slot map + pending queue in checkpoint order; jobs
+        # the checkpoint never saw stay pending at the back (in add_job
+        # order, which the initial _pending_jobs preserved)
+        self._slots = [None] * self.capacity
+        for slot, name in enumerate(meta.get("slots", [])):
+            if name is not None and name in by_name:
+                job = by_name[name]
+                job.slot = slot
+                self._slots[slot] = job
+        pend = [by_name[n] for n in meta.get("pending", ())
+                if n in by_name]
+        new = [j for j in self.jobs
+               if j.name not in job_meta and j.status == "pending"]
+        self._pending_jobs = collections.deque(pend + new)
+        logger.info(
+            "Fleet resumed from %s at fused step %d: %d running, %d "
+            "pending, %d done, %d failed.", ckpt_path, self.global_step,
+            sum(1 for s in self._slots if s is not None),
+            len(self._pending_jobs),
+            sum(1 for j in self.jobs if j.status == "done"),
+            sum(1 for j in self.jobs if j.status == "failed"))
+        return self
+
     # -- the fused loop ----------------------------------------------------
 
     def _build_batch(self) -> Dict[str, np.ndarray]:
@@ -664,10 +823,18 @@ class FusedLoRATrainer:
                                  scaling=self.alpha / self.rank,
                                  horizon=horizons)
 
-    def run(self) -> "FusedLoRATrainer":
+    def run(self, stopper=None) -> "FusedLoRATrainer":
         """Train every queued job to completion (admitting into freed
         slots as earlier jobs finish), exporting each artifact the moment
-        its job is done. Returns self."""
+        its job is done. Returns self.
+
+        ``stopper`` (training/resilience.GracefulStopper): SIGTERM/SIGINT
+        stop the fleet at the next step boundary — metrics flushed, one
+        step-tagged checkpoint written (``save_checkpoint``) — so a
+        relaunch with ``--resume auto`` continues every job's loss
+        trajectory bit-for-bit. ``save_every`` fused steps additionally
+        checkpoint at flush boundaries (retention-GC'd to
+        ``keep_ckpts``)."""
         t0 = time.monotonic()
         split = fleet_flops_split(self.cfg, self.rank)
         self.metrics_sink.event(
@@ -680,6 +847,24 @@ class FusedLoRATrainer:
         window_tokens, window_t0 = 0, time.perf_counter()
         try:
             while self._running():
+                if stopper is not None and stopper.should_stop():
+                    # preemption: flush (so ledgers are current), write
+                    # ONE step-tagged checkpoint, stop at the boundary —
+                    # the PR 1 trainer's stop discipline, fleet-wide
+                    self.preempted = True
+                    self._flush(window_tokens,
+                                time.perf_counter() - window_t0)
+                    window_tokens, window_t0 = 0, time.perf_counter()
+                    path = self.save_checkpoint()
+                    self.metrics_sink.event(
+                        "preemption_stop", step=self.global_step,
+                        tokens_seen=self.tokens_seen)
+                    logger.warning(
+                        "Fleet preempted at fused step %d%s; relaunch "
+                        "with --resume auto to continue.",
+                        self.global_step,
+                        f" (checkpoint {path})" if path else "")
+                    break
                 batch = self._build_batch()
                 self.state, metrics = self._step_fn(self.state, batch)
                 if self._watcher is not None and self.global_step == 0:
@@ -699,7 +884,10 @@ class FusedLoRATrainer:
                     job.steps_done += 1
                     if job.steps_done >= job.total_steps:
                         due.append(job)
-                if due or self.global_step % self.log_every == 0:
+                save_due = (self.save_every > 0
+                            and self.global_step % self.save_every == 0)
+                if due or save_due \
+                        or self.global_step % self.log_every == 0:
                     self._flush(window_tokens,
                                 time.perf_counter() - window_t0)
                     window_tokens, window_t0 = 0, time.perf_counter()
@@ -707,6 +895,8 @@ class FusedLoRATrainer:
                         if job.status == "running":
                             self._finish(job)
                     self._admit_pending()
+                    if save_due:
+                        self.save_checkpoint()
         except KeyboardInterrupt:
             self.preempted = True
             logger.warning("Fleet interrupted at fused step %d.",
@@ -896,6 +1086,10 @@ def run_finetune_fleet(args, comps, metric_logger) -> FusedLoRATrainer:
     from building_llm_from_scratch_tpu.serving.frontend import (
         parse_adapter_specs,
     )
+    from building_llm_from_scratch_tpu.training.resilience import (
+        GracefulStopper,
+        resolve_resume,
+    )
     from building_llm_from_scratch_tpu.utils.io import read_json_file
 
     specs = parse_adapter_specs(args.fleet_jobs, flag="--fleet_jobs")
@@ -908,7 +1102,9 @@ def run_finetune_fleet(args, comps, metric_logger) -> FusedLoRATrainer:
         rows_per_job=args.fleet_rows_per_job,
         peak_lr=args.lr, initial_lr=args.initial_lr, min_lr=args.min_lr,
         warmup_steps=args.warmup_steps, seed=args.seed,
-        log_every=(args.log_every or 10), export_dir=export_dir)
+        log_every=(args.log_every or 10), export_dir=export_dir,
+        ckpt_dir=args.output_dir, save_every=args.save_ckpt_freq,
+        keep_ckpts=args.keep_ckpts)
     for name, path in specs.items():
         records = read_json_file(path)
         engine.add_job(FinetuneJob.from_records(
@@ -918,11 +1114,30 @@ def run_finetune_fleet(args, comps, metric_logger) -> FusedLoRATrainer:
             n_epochs=args.n_epochs, pad_token_id=comps.cfg.eos_id,
             seed=args.seed, style=args.fleet_style,
             export_path=os.path.join(export_dir, f"{name}.npz")))
-    engine.run()
+    # fault tolerance: --resume auto discovers the latest VALID fleet
+    # checkpoint in --output_dir (manifest-validated, PR 1 machinery);
+    # SIGTERM/SIGINT checkpoint-and-stop at the next fused-step boundary.
+    # The predicate skips TRAINER checkpoints sharing the output_dir —
+    # auto-discovery must not pick one and die in restore(); an explicit
+    # --resume_from still refuses loudly there
+    resume_dir = resolve_resume(getattr(args, "resume", "auto"),
+                                args.resume_from, args.output_dir,
+                                predicate=lambda meta: bool(
+                                    meta.get("fleet")))
+    if resume_dir is not None:
+        engine.restore(resume_dir)
+    with GracefulStopper() as stopper:
+        engine.run(stopper=stopper)
     done = [j.name for j in engine.jobs if j.status == "done"]
     failed = [j.name for j in engine.jobs if j.status == "failed"]
-    logger.info("Fleet complete: %d/%d jobs exported (%s)%s.",
-                len(done), len(engine.jobs), ", ".join(done) or "none",
-                f"; failed: {', '.join(failed)}" if failed else "")
+    if engine.preempted:
+        logger.warning(
+            "Fleet preempted: %d/%d jobs exported; relaunch the same "
+            "command to resume (--resume auto).", len(done),
+            len(engine.jobs))
+    else:
+        logger.info("Fleet complete: %d/%d jobs exported (%s)%s.",
+                    len(done), len(engine.jobs), ", ".join(done) or "none",
+                    f"; failed: {', '.join(failed)}" if failed else "")
     metric_logger.close()
     return engine

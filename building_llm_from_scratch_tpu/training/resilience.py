@@ -222,12 +222,42 @@ def list_checkpoints(output_dir: str) -> List[Tuple[int, str]]:
     return sorted(out)
 
 
-def find_latest_valid_checkpoint(output_dir: str) -> Optional[str]:
+def find_latest_valid_checkpoint(output_dir: str,
+                                 predicate=None) -> Optional[str]:
     """The newest checkpoint (by recorded global_step) that passes
     ``validate_checkpoint``. Corrupt candidates are logged LOUDLY and
     skipped, so a truncated latest checkpoint falls back to the previous
-    valid one instead of crashing the resume."""
+    valid one instead of crashing the resume.
+
+    ``predicate(metadata) -> bool`` filters candidates by manifest
+    metadata: trainer and fleet (``--mode finetune_fleet``) checkpoints
+    share the ``model_pg_`` prefix and one ``--output_dir``, so each
+    mode's AUTO-discovery must skip the other's checkpoints QUIETLY
+    (they are valid, just not restorable here) instead of picking one
+    and dying in the restore — the loud type refusal is reserved for an
+    explicitly named ``--resume_from``."""
+    from building_llm_from_scratch_tpu.training.checkpoint import (
+        checkpoint_metadata,
+    )
+
     for step, path in reversed(list_checkpoints(output_dir)):
+        if predicate is not None:
+            try:
+                keep = predicate(checkpoint_metadata(path))
+            except (ValueError, OSError) as e:
+                # discovery must NEVER raise: a candidate that vanished
+                # or corrupted between listing and filtering (e.g. a
+                # concurrent run's retention GC) is skipped like any
+                # other invalid checkpoint
+                logger.error(
+                    "Checkpoint %s became unreadable during resume "
+                    "discovery (%s) — skipping it.", path, e)
+                continue
+            if not keep:
+                logger.info(
+                    "Resume discovery: skipping %s (another run mode's "
+                    "checkpoint).", path)
+                continue
         reason = validate_checkpoint(path)
         if reason is None:
             return path
@@ -240,7 +270,7 @@ def find_latest_valid_checkpoint(output_dir: str) -> Optional[str]:
 
 
 def resolve_resume(resume: Optional[str], resume_from: Optional[str],
-                   output_dir: str) -> Optional[str]:
+                   output_dir: str, predicate=None) -> Optional[str]:
     """Turn the (--resume, --resume_from) flag pair into a checkpoint dir
     (or None for a fresh start).
 
@@ -249,6 +279,10 @@ def resolve_resume(resume: Optional[str], resume_from: Optional[str],
     under ``output_dir`` — a relaunched preempted job resumes with the
     exact command that started it. ``--resume off`` forces a fresh start;
     any other value is taken as an explicit checkpoint dir.
+
+    ``predicate`` applies ONLY to auto-discovery (see
+    ``find_latest_valid_checkpoint``): explicitly named checkpoints go
+    through so the restore path can refuse them loudly.
     """
     if resume_from is not None:
         return resume_from
@@ -256,14 +290,15 @@ def resolve_resume(resume: Optional[str], resume_from: Optional[str],
         return None
     if resume != "auto":
         return resume
-    found = find_latest_valid_checkpoint(output_dir)
+    found = find_latest_valid_checkpoint(output_dir, predicate=predicate)
     if found is not None:
         logger.info("--resume auto: found checkpoint %s", found)
     return found
 
 
 def resolve_resume_agreed(resume: Optional[str], resume_from: Optional[str],
-                          output_dir: str) -> Optional[str]:
+                          output_dir: str,
+                          predicate=None) -> Optional[str]:
     """Multi-host-safe ``resolve_resume``: the coordinator alone runs the
     discovery + validation pass (one full-checkpoint hash read instead of
     one per host) and shares its choice through a marker file on the shared
@@ -274,14 +309,16 @@ def resolve_resume_agreed(resume: Optional[str], resume_from: Optional[str],
     import jax
 
     if jax.process_count() == 1:
-        return resolve_resume(resume, resume_from, output_dir)
+        return resolve_resume(resume, resume_from, output_dir,
+                              predicate=predicate)
     from building_llm_from_scratch_tpu.parallel.collectives import (
         sync_global_devices,
     )
 
     marker = os.path.join(output_dir, ".resume_choice")
     if jax.process_index() == 0:
-        choice = resolve_resume(resume, resume_from, output_dir)
+        choice = resolve_resume(resume, resume_from, output_dir,
+                                predicate=predicate)
         with open(marker, "w") as f:
             f.write(choice or "")
     sync_global_devices("resume_choice_written")

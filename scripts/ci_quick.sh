@@ -320,6 +320,66 @@ print(f"kv memory engine smoke ok: 8/8 requests, "
       f"({warm['kv_bytes_per_slot']}B/slot), 0 recompiles")
 EOF
 
+echo "== speculative decoding smoke (train repetitive -> spec serve, CPU) =="
+JAX_PLATFORMS=cpu python - <<'EOF' || exit 1
+import json, os, tempfile
+d = tempfile.mkdtemp()
+data = os.path.join(d, "data"); os.makedirs(data)
+# REAL CLI path end-to-end: a short debug train run on a strongly
+# repetitive byte corpus (so greedy decode actually continues the cycle
+# — an untrained model's output is positional noise no self-history
+# drafter can predict), exported via model_pg_final.npz, then served
+# with --serve_spec_k 4: the n-gram drafter must earn acceptance on the
+# workload prompt-lookup decoding exists for.
+open(os.path.join(data, "corpus.txt"), "w").write("abcdefgh" * 400)
+out = os.path.join(d, "out")
+from building_llm_from_scratch_tpu.args import get_args
+from building_llm_from_scratch_tpu.main import main
+main(get_args([
+    "--data_dir", data, "--output_dir", out, "--debug", "--byte_tokenizer",
+    "--n_epochs", "2", "--batch_size", "8", "--eval_freq", "100000",
+    "--print_sample_iter", "100000", "--save_ckpt_freq", "100000",
+    "--warmup_steps", "2",
+]))
+final = os.path.join(out, "model_pg_final.npz")
+assert os.path.isfile(final), "train run exported no final params"
+reqs = os.path.join(d, "requests.jsonl")
+with open(reqs, "w") as f:
+    for i in range(8):
+        f.write(json.dumps({"prompt": ("abcdefgh" * 2)[i: i + 6],
+                            "max_new_tokens": 8,
+                            "ignore_eos": True, "seed": i}) + "\n")
+res = os.path.join(d, "results.jsonl")
+mj = os.path.join(d, "metrics.jsonl")
+engine = main(get_args([
+    "--mode", "serve", "--debug", "--byte_tokenizer", "--data_dir", d,
+    "--init_params_from", final,
+    "--serve_prompts", reqs, "--serve_out", res,
+    "--serve_slots", "4", "--serve_max_queue", "8",
+    "--serve_spec_k", "4", "--serve_metrics_every", "2",
+    "--metrics_jsonl", mj,
+]))
+results = [json.loads(l) for l in open(res)]
+assert len(results) == 8, f"expected 8 results, got {len(results)}"
+assert all(r["finish_reason"] == "length" for r in results), results
+rows = [json.loads(l) for l in open(mj)]
+done = [r for r in rows if r.get("event") == "request_done"]
+accepted = sum(r.get("spec_accepted", 0) for r in done)
+drafted = sum(r.get("spec_drafted", 0) for r in done)
+assert accepted > 0, f"no accepted draft tokens ({drafted} drafted)"
+acc_windows = [r for r in rows if r.get("type") == "metrics"
+               and r.get("spec_accepted", 0) > 0]
+assert acc_windows, "no tick window with accepted > 0"
+recompiles = [r for r in rows if r.get("event") == "recompile"]
+assert not recompiles, f"spec traffic recompiled: {recompiles}"
+assert engine.n_recompiles == 0
+warm = [r for r in rows if r.get("event") == "serve_warmup"][0]
+assert warm["spec_k"] == 4, warm
+print(f"spec smoke ok: 8/8 requests, {accepted}/{drafted} drafts "
+      f"accepted ({100*accepted/max(drafted,1):.0f}%), "
+      f"{len(acc_windows)} accepting windows, 0 recompiles")
+EOF
+
 echo "== serving drain smoke (SIGTERM + mid-run /metrics scrape, CPU) =="
 JAX_PLATFORMS=cpu python - <<'EOF' || exit 1
 import json, os, signal, socket, subprocess, sys, tempfile, time

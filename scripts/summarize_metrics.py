@@ -186,6 +186,7 @@ def summarize_serving(metrics, events):
     summarize_serving_resilience(failed, shed, expired, events)
     summarize_adapters(done, failed, events)
     summarize_prefix_kv(metrics, events)
+    summarize_spec(done, metrics, events)
     for key, label in (("queue_wait_s", "queue wait"), ("ttft_s", "TTFT"),
                        ("tpot_s", "TPOT"), ("e2e_s", "end-to-end")):
         vals = [e[key] for e in done
@@ -251,6 +252,57 @@ def summarize_adapters(done, failed, events):
             line += (f"  e2e p50 {1e3 * _pctile(t['e2e'], 50):8.2f} ms  "
                      f"p95 {1e3 * _pctile(t['e2e'], 95):8.2f} ms")
         print(line)
+
+
+def summarize_spec(done, metrics, events):
+    """Speculative-decoding section (serving/spec.py): the drafter
+    config from ``serve_warmup``, the fleet-wide acceptance ratio
+    (accepted/drafted — the drafter-quality dial: low ratio means the
+    k-wide verify positions are wasted compute, so shrink k or opt the
+    workload out), drafted-vs-accepted per cadence window, and the
+    per-request acceptance spread + TPOT next to it (TPOT is the
+    latency speculation attacks — compare a spec-off run of the same
+    workload for the delta)."""
+    warm = [e for e in events if e["event"] == "serve_warmup"]
+    spec_k = (warm[-1].get("spec_k") if warm else None) or 0
+    drafted = sum(e.get("spec_drafted", 0) for e in done)
+    if not spec_k and not drafted:
+        return
+    print("  -- speculative decoding --")
+    if warm and spec_k:
+        print(f"  config: k={spec_k}, drafter="
+              f"{warm[-1].get('drafter', '?')}")
+    accepted = sum(e.get("spec_accepted", 0) for e in done)
+    if drafted:
+        print(f"  acceptance: {accepted}/{drafted} drafted tokens "
+              f"accepted ({100 * accepted / drafted:.0f}%) across "
+              f"{sum(1 for e in done if e.get('spec_drafted'))} "
+              "request(s)")
+        ratios = [e["spec_accepted"] / e["spec_drafted"] for e in done
+                  if e.get("spec_drafted")]
+        if ratios:
+            print(f"  per-request acceptance: p50 "
+                  f"{100 * _pctile(ratios, 50):.0f}%  p95 "
+                  f"{100 * _pctile(ratios, 95):.0f}%  min "
+                  f"{100 * min(ratios):.0f}% (persistently-low tenants "
+                  "are 'spec': false candidates)")
+        tpots = [e["tpot_s"] for e in done
+                 if e.get("spec_drafted")
+                 and isinstance(e.get("tpot_s"), (int, float))]
+        if tpots:
+            print(f"  TPOT under speculation: p50 "
+                  f"{1e3 * _pctile(tpots, 50):.2f} ms (A/B a spec-off "
+                  "run — bench.py serve_spec — for the delta)")
+    rows = [r for r in metrics if r.get("spec_drafted")]
+    if rows:
+        worst = sorted(rows, key=lambda r: r.get("spec_accepted", 0)
+                       / max(r.get("spec_drafted", 1), 1))[:3]
+        print(f"  windows: {len(rows)} cadence window(s) drafted; "
+              "lowest-acceptance windows: "
+              + ", ".join(
+                  f"step {r.get('step', '?')} "
+                  f"{100 * r.get('spec_accepted', 0) / max(r.get('spec_drafted', 1), 1):.0f}%"
+                  for r in worst))
 
 
 def summarize_prefix_kv(metrics, events):

@@ -480,3 +480,282 @@ def test_job_from_records_plain_style(cfg):
         FinetuneJob.from_records(
             "t2", records[:1], tok, max_length=cfg.context_length,
             rows_per_step=2, n_epochs=1, pad_token_id=cfg.eos_id)
+
+
+# ---------------------------------------------------------------------------
+# Slot-aligned adapter application (ROADMAP PR 12 follow-up)
+# ---------------------------------------------------------------------------
+
+def test_aligned_matches_gather_path_k3(cfg, base_params):
+    """The slot-aligned ``(J, R*T)`` application (default) trains each
+    job identically to the historical per-row gather: k=3 per-job
+    losses within 1e-5 and adapter params within 5e-6 after 6 steps —
+    the reshape removes the rows_per_job-fold A/B duplication, not any
+    math. (The HLO difference is what the re-baselined
+    ``micro_lora_fusion`` fingerprint pins.)"""
+    k, rows, n, horizon = 3, 2, 6, 8
+    jobs = []
+    for j in range(k):
+        jb = _job_arrays(cfg, rows, seed=j)
+        jb["lora"] = init_lora_params(cfg, base_params,
+                                      jax.random.PRNGKey(10 + j),
+                                      rank=RANK)
+        jobs.append(jb)
+    batch = _fused_batch(jobs, rows, k, horizon)
+
+    def run(aligned):
+        state = init_fleet_state(cfg, base_params, capacity=k, rank=RANK,
+                                 rng=jax.random.PRNGKey(123))
+        for j in range(k):
+            state["trainable"] = _set_row(state["trainable"], j,
+                                          _copy(jobs[j]["lora"]))
+        step = make_fused_train_step(cfg, capacity=k, warmup_steps=2,
+                                     aligned=aligned)
+        losses = []
+        for _ in range(n):
+            state, m = step(state, batch)
+            losses.append(np.asarray(jax.device_get(m["loss"])))
+        return np.stack(losses), jax.device_get(state["trainable"])
+
+    l_aligned, p_aligned = run(True)
+    l_gather, p_gather = run(False)
+    np.testing.assert_allclose(l_aligned, l_gather, rtol=1e-5, atol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(p_aligned),
+                    jax.tree_util.tree_leaves(p_gather)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-6, rtol=0)
+
+
+def test_aligned_rejects_misaligned_batch(cfg, base_params):
+    """The aligned path is only valid for the stack_fleet_batch layout:
+    a row count not divisible by rows_per_job is a loud error, not a
+    silently mis-bucketed delta."""
+    from building_llm_from_scratch_tpu.models.transformer import (
+        forward_hidden,
+    )
+
+    pool = jax.tree_util.tree_map(
+        lambda a: jnp.zeros((2,) + a.shape, a.dtype),
+        init_lora_params(cfg, base_params, jax.random.PRNGKey(0),
+                         rank=RANK))
+    tokens = np.zeros((3, cfg.context_length), np.int32)  # 3 % 2 != 0
+    with pytest.raises(ValueError, match="rows_per_job"):
+        forward_hidden(base_params, cfg, tokens,
+                       adapter={"pool": pool,
+                                "scaling": jnp.ones((2,), jnp.float32),
+                                "rows_per_job": 2})
+
+
+# ---------------------------------------------------------------------------
+# Fleet checkpoint / resume (PR 1 machinery on the stacked pool state)
+# ---------------------------------------------------------------------------
+
+def _ckpt_jobs(cfg, tok, n_epochs=3):
+    def records(vocab):
+        return [{"instruction": vocab[i % 4] * 2, "input": "",
+                 "output": vocab[(i + 1) % 4] * 3} for i in range(8)]
+
+    return [FinetuneJob.from_records(
+        name, records(vocab), tok, max_length=cfg.context_length,
+        rows_per_step=2, n_epochs=n_epochs, pad_token_id=cfg.eos_id,
+        style="plain") for name, vocab in (("ja", "abcd"), ("jb", "wxyz"))]
+
+
+def _tracked_run(engine, record, stop_at=None, signal_at=None):
+    """Run a fleet recording each flushed step's per-job losses; with
+    ``signal_at``, deliver a REAL SIGTERM (to this process, through a
+    GracefulStopper) once global_step reaches it."""
+    import os
+    import signal as _signal
+
+    from building_llm_from_scratch_tpu.training.resilience import (
+        GracefulStopper,
+    )
+
+    orig_flush = engine._flush
+
+    def wrapped(*a, **kw):
+        orig_flush(*a, **kw)
+        if engine._last_fetched is not None:
+            record[engine.global_step] = [
+                round(float(x), 10) for x in engine._last_fetched["loss"]]
+
+    engine._flush = wrapped
+    if signal_at is not None:
+        def on_step(eng):
+            if eng.global_step == signal_at:
+                os.kill(os.getpid(), _signal.SIGTERM)
+
+        engine.on_step = on_step
+        with GracefulStopper() as stopper:
+            engine.run(stopper=stopper)
+    else:
+        engine.run()
+    return engine
+
+
+def test_fleet_sigterm_resume_bit_for_bit(cfg, base_params, tmp_path):
+    """SIGTERM mid-fleet -> step-boundary checkpoint -> `--resume auto`
+    discovery -> per-job loss trajectories continue BIT-FOR-BIT: the
+    stacked pool state round-trips through the PR 1 sharded-manifest
+    checkpoint, and each job's batch cursor fast-forwards to the exact
+    (epoch, index) the preempted run stopped at."""
+    from building_llm_from_scratch_tpu.data.tokenizers import (
+        build_tokenizer,
+    )
+    from building_llm_from_scratch_tpu.training.resilience import (
+        find_latest_valid_checkpoint,
+    )
+
+    tok = build_tokenizer("GPT2", None, fallback_byte=True)
+
+    def make(ckpt_dir=None):
+        eng = FusedLoRATrainer(
+            cfg, base_params, tokenizer=tok, capacity=2, rank=RANK,
+            alpha=ALPHA, rows_per_job=2, log_every=1,
+            export_dir=str(tmp_path / "adapters"),
+            ckpt_dir=ckpt_dir, compile_telemetry=False)
+        for job in _ckpt_jobs(cfg, tok):
+            eng.add_job(job)
+        return eng
+
+    reference = {}
+    _tracked_run(make(), reference)
+    assert len(reference) == 12          # 2 jobs x (8//2) x 3 epochs
+
+    ckpt_dir = str(tmp_path / "ckpts")
+    resumed = {}
+    first = _tracked_run(make(ckpt_dir), resumed, signal_at=5)
+    assert first.preempted
+    assert all(j.status == "running" for j in first.jobs)
+    found = find_latest_valid_checkpoint(ckpt_dir)
+    assert found is not None and found.endswith("model_pg_5")
+
+    second = make(ckpt_dir)
+    second.restore(found)
+    assert second.global_step == 5
+    _tracked_run(second, resumed)
+    assert not second.preempted
+    assert all(j.status == "done" for j in second.jobs)
+    assert resumed == reference          # bit-for-bit, pre AND post resume
+
+
+def test_fleet_restore_refuses_mismatched_shape(cfg, base_params,
+                                                tmp_path):
+    """A checkpoint from a different fleet geometry (capacity/rank) or a
+    non-fleet checkpoint refuses loudly instead of silently restoring
+    the wrong pool."""
+    from building_llm_from_scratch_tpu.data.tokenizers import (
+        build_tokenizer,
+    )
+
+    tok = build_tokenizer("GPT2", None, fallback_byte=True)
+    eng = FusedLoRATrainer(cfg, base_params, tokenizer=tok, capacity=2,
+                           rank=RANK, alpha=ALPHA, rows_per_job=2,
+                           ckpt_dir=str(tmp_path),
+                           compile_telemetry=False)
+    for job in _ckpt_jobs(cfg, tok, n_epochs=1):
+        eng.add_job(job)
+    eng._admit_pending()
+    path = eng.save_checkpoint()
+    assert path is not None
+
+    other = FusedLoRATrainer(cfg, base_params, tokenizer=tok, capacity=3,
+                             rank=RANK, alpha=ALPHA, rows_per_job=2,
+                             compile_telemetry=False)
+    with pytest.raises(ValueError, match="capacity/rank"):
+        other.restore(path)
+
+    # a non-fleet manifest (no fleet flag) refuses before touching state
+    from building_llm_from_scratch_tpu.training.checkpoint import (
+        save_checkpoint,
+    )
+
+    plain = str(tmp_path / "model_pg_99")
+    save_checkpoint(plain, {"w": jnp.zeros((2,))},
+                    extra_metadata={"global_step": 99})
+    with pytest.raises(ValueError, match="not a fleet checkpoint"):
+        eng.restore(plain)
+
+
+def test_resume_discovery_filters_by_run_mode(cfg, base_params, tmp_path):
+    """Trainer and fleet checkpoints share the model_pg_ prefix and often
+    one --output_dir: each mode's AUTO-discovery must skip the other's
+    checkpoints quietly (start fresh / find an older matching one)
+    instead of picking the wrong type and dying in the restore."""
+    from building_llm_from_scratch_tpu.data.tokenizers import (
+        build_tokenizer,
+    )
+    from building_llm_from_scratch_tpu.training.checkpoint import (
+        save_checkpoint,
+    )
+    from building_llm_from_scratch_tpu.training.resilience import (
+        resolve_resume,
+    )
+
+    out = str(tmp_path)
+    fleet_pred = lambda meta: bool(meta.get("fleet"))      # noqa: E731
+    train_pred = lambda meta: not meta.get("fleet")        # noqa: E731
+
+    # a TRAINER checkpoint alone: fleet auto-resume starts fresh
+    save_checkpoint(os.path.join(out, "model_pg_7"),
+                    {"w": jnp.zeros((2,))},
+                    extra_metadata={"global_step": 7})
+    assert resolve_resume("auto", None, out, predicate=fleet_pred) is None
+    # ...while trainer auto-resume finds it
+    got = resolve_resume("auto", None, out, predicate=train_pred)
+    assert got is not None and got.endswith("model_pg_7")
+
+    # add a NEWER fleet checkpoint: each mode now finds its own
+    tok = build_tokenizer("GPT2", None, fallback_byte=True)
+    eng = FusedLoRATrainer(cfg, base_params, tokenizer=tok, capacity=2,
+                           rank=RANK, alpha=ALPHA, rows_per_job=2,
+                           ckpt_dir=out, compile_telemetry=False)
+    for job in _ckpt_jobs(cfg, tok, n_epochs=1):
+        eng.add_job(job)
+    eng._admit_pending()
+    eng.global_step = 9
+    eng.save_checkpoint()
+    got = resolve_resume("auto", None, out, predicate=fleet_pred)
+    assert got is not None and got.endswith("model_pg_9")
+    got = resolve_resume("auto", None, out, predicate=train_pred)
+    assert got is not None and got.endswith("model_pg_7")
+    # an EXPLICIT wrong-type path still refuses loudly in restore()
+    with pytest.raises(ValueError, match="not a fleet checkpoint"):
+        eng.restore(os.path.join(out, "model_pg_7"))
+
+
+def test_resume_discovery_survives_vanished_candidate(tmp_path,
+                                                      monkeypatch):
+    """Discovery must never raise: a candidate that becomes unreadable
+    between listing and the predicate's metadata read (a concurrent
+    run's retention GC deleting it) is skipped like any other invalid
+    checkpoint instead of crashing --resume auto."""
+    from building_llm_from_scratch_tpu.training import (
+        checkpoint as ckpt_mod,
+    )
+    from building_llm_from_scratch_tpu.training.resilience import (
+        find_latest_valid_checkpoint,
+    )
+
+    out = str(tmp_path)
+    for step in (3, 5):
+        ckpt_mod.save_checkpoint(
+            os.path.join(out, f"model_pg_{step}"),
+            {"w": jnp.zeros((2,))}, extra_metadata={"global_step": step})
+
+    # model_pg_5 survives LISTING (first metadata read per path) but
+    # "vanishes" before the predicate's own read (the second) — exactly
+    # the GC race window
+    real_metadata = ckpt_mod.checkpoint_metadata
+    calls = {}
+
+    def racing_metadata(path):
+        calls[path] = calls.get(path, 0) + 1
+        if path.endswith("model_pg_5") and calls[path] >= 2:
+            raise ValueError("manifest.json is missing (deleted by GC)")
+        return real_metadata(path)
+
+    monkeypatch.setattr(ckpt_mod, "checkpoint_metadata", racing_metadata)
+    got = find_latest_valid_checkpoint(out, predicate=lambda meta: True)
+    assert got is not None and got.endswith("model_pg_3")

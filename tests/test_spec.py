@@ -1,0 +1,440 @@
+"""Speculative decoding (serving/spec.py + models/transformer.verify_slots
++ the engine's draft-and-verify tick): n-gram drafter units, BIT-parity of
+engine tokens spec-on vs spec-off (greedy AND seeded sampling, at 0%,
+mixed and ~100% acceptance), zero recompiles across acceptance churn
+under the frozen watcher, per-request opt-out, composition with int8 KV
+and chunked prefill, the near-capacity position clamp, and the
+acceptance telemetry."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from building_llm_from_scratch_tpu.configs import ModelConfig
+from building_llm_from_scratch_tpu.models import init_params
+from building_llm_from_scratch_tpu.obs.metrics import configure_metrics
+from building_llm_from_scratch_tpu.serving import (
+    DecodeEngine,
+    Drafter,
+    KVCachePolicy,
+    NgramDrafter,
+    SamplingParams,
+)
+
+
+def tiny_cfg(ctx=64, **kw):
+    base = dict(name="spec-tiny", vocab_size=96, context_length=ctx,
+                emb_dim=32, n_heads=2, n_layers=2, hidden_dim=64,
+                n_kv_groups=2, norm="layernorm", positional="learned",
+                activation="gelu", drop_rate=0.0, eos_id=1)
+    base.update(kw)
+    return ModelConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_cfg()
+    return cfg, init_params(cfg, jax.random.PRNGKey(0))
+
+
+def _mixed_requests(cfg, n=6, max_new=16, prompt_len=8, seed=0):
+    """Mixed traffic: greedy and seeded-sampled rows, assorted budgets."""
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(2, cfg.vocab_size, (prompt_len,)
+                            ).astype(np.int32) for _ in range(n)]
+    params = [SamplingParams(max_new_tokens=max_new - (i % 3),
+                             ignore_eos=True, seed=i,
+                             temperature=0.7 if i % 2 else 0.0,
+                             top_k=8 if i % 2 else None)
+              for i in range(n)]
+    return prompts, params
+
+
+def _run_engine(cfg, params, prompts, sps, *, spec_k=0, drafter=None,
+                n_slots=2, kv_policy=None, max_len=None):
+    eng = DecodeEngine(cfg, params, n_slots=n_slots,
+                       max_queue=len(prompts), warmup_prompt_cap=16,
+                       spec_k=spec_k, drafter=drafter,
+                       kv_policy=kv_policy, max_len=max_len)
+    eng.warmup()
+    handles = [eng.submit(p, sp, block=True)
+               for p, sp in zip(prompts, sps)]
+    eng.run_until_idle()
+    outs = [list(h.output_ids) for h in handles]
+    reasons = [h.finish_reason for h in handles]
+    return eng, handles, outs, reasons
+
+
+# ---------------------------------------------------------------------------
+# Drafter units
+# ---------------------------------------------------------------------------
+
+def test_ngram_drafter_matches_most_recent_occurrence():
+    d = NgramDrafter(max_n=3, min_n=1)
+    # history: ... [7 8 9] 4 ... [7 8 9] 5 ... suffix [7 8 9] -> the MOST
+    # RECENT earlier occurrence continues with 5
+    hist = np.asarray([7, 8, 9, 4, 1, 7, 8, 9, 5, 6, 7, 8, 9], np.int32)
+    np.testing.assert_array_equal(d.propose(hist, 2), [5, 6])
+
+
+def test_ngram_drafter_prefers_longer_match():
+    d = NgramDrafter(max_n=2, min_n=1)
+    # suffix [3 4]: bigram occurs at (3,4)->5 earlier; the unigram [4]
+    # ALSO occurs later followed by 9 — the longer match must win
+    hist = np.asarray([3, 4, 5, 4, 9, 3, 4], np.int32)
+    np.testing.assert_array_equal(d.propose(hist, 1), [5])
+
+
+def test_ngram_drafter_no_match_falls_back_to_last_token():
+    d = NgramDrafter(max_n=3, min_n=1)
+    hist = np.asarray([10, 11, 12, 13], np.int32)   # all distinct
+    np.testing.assert_array_equal(d.propose(hist, 3), [13, 13, 13])
+
+
+def test_ngram_drafter_history_shorter_than_n():
+    d = NgramDrafter(max_n=3, min_n=1)
+    # one token: no n-gram (even unigram needs an EARLIER occurrence)
+    np.testing.assert_array_equal(d.propose(
+        np.asarray([5], np.int32), 2), [5, 5])
+    # two tokens, repeated unigram: [5] recurs -> continue with 5
+    np.testing.assert_array_equal(d.propose(
+        np.asarray([5, 5], np.int32), 2), [5, 5])
+
+
+def test_ngram_drafter_pads_continuation_off_the_end():
+    d = NgramDrafter(max_n=1, min_n=1)
+    # unigram [2] matches at index 0; only [8, 2] remain after it — the
+    # k=3 draft pads the short continuation with its last token
+    hist = np.asarray([2, 8, 2], np.int32)
+    np.testing.assert_array_equal(d.propose(hist, 3), [8, 2, 2])
+
+
+# ---------------------------------------------------------------------------
+# Multi-position sampling parity (the verify program's sampling core)
+# ---------------------------------------------------------------------------
+
+def test_sample_tokens_multi_rowwise_equals_single_position():
+    """Every (slot, position) of the flattened multi-position sampler is
+    bit-identical to sample_tokens_dynamic on that row alone — the
+    property the exact accept rule stands on."""
+    from building_llm_from_scratch_tpu.generate import (
+        sample_tokens_dynamic,
+        sample_tokens_multi,
+        token_rng,
+    )
+
+    S, Tq, V = 3, 4, 32
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(S, Tq, V)).astype(np.float32))
+    temps = jnp.asarray([0.0, 0.8, 1.3], jnp.float32)
+    topks = jnp.asarray([0, 5, 0], jnp.int32)
+    base = jax.vmap(jax.random.PRNGKey)(jnp.arange(S))
+    offs = jnp.arange(Tq)[None, :] + jnp.asarray([[0], [3], [7]])
+    keys = jax.vmap(jax.vmap(token_rng, in_axes=(None, 0)))(base, offs)
+    multi = np.asarray(sample_tokens_multi(logits, keys, temps, topks, 8))
+    for s in range(S):
+        for j in range(Tq):
+            one = sample_tokens_dynamic(
+                logits[s, j][None], keys[s, j][None], temps[s][None],
+                topks[s][None], 8)
+            assert int(one[0]) == multi[s, j], (s, j)
+
+
+# ---------------------------------------------------------------------------
+# Engine parity: spec-on tokens == spec-off tokens, bit for bit
+# ---------------------------------------------------------------------------
+
+def test_greedy_and_sampled_bit_parity_mixed_traffic(model):
+    cfg, params = model
+    prompts, sps = _mixed_requests(cfg)
+    _, _, ref, ref_r = _run_engine(cfg, params, prompts, sps)
+    eng, _, out, out_r = _run_engine(cfg, params, prompts, sps, spec_k=4)
+    assert out == ref and out_r == ref_r
+    assert eng.n_recompiles == 0
+
+
+class _OracleDrafter(Drafter):
+    """Drafts the TRUE continuation from recorded spec-off sequences —
+    forces ~100% acceptance (the other extreme from a never-right
+    drafter), so parity is pinned at both acceptance boundaries."""
+
+    def __init__(self, sequences):
+        self.sequences = [np.asarray(s, np.int32) for s in sequences]
+
+    def propose(self, history, k):
+        L = history.shape[0]
+        for seq in self.sequences:
+            if L <= seq.shape[0] and np.array_equal(seq[:L], history):
+                cont = seq[L: L + k]
+                if cont.shape[0] == k:
+                    return cont
+                pad = np.full((k - cont.shape[0],),
+                              history[-1], np.int32)
+                return np.concatenate([cont, pad])
+        return super().propose(history, k)
+
+
+class _WrongDrafter(Drafter):
+    """Never drafts anything useful (constant token): ~0% acceptance."""
+
+    def propose(self, history, k):
+        return np.full((k,), 3, np.int32)
+
+
+def test_parity_pinned_at_acceptance_extremes(model):
+    """Rejection-sampling/argmax acceptance preserves the token stream
+    EXACTLY whatever the drafter proposes: an oracle drafter (~full
+    acceptance) and a useless one (~zero) both reproduce the
+    non-speculative engine bit-for-bit, greedy and sampled rows alike."""
+    cfg, params = model
+    prompts, sps = _mixed_requests(cfg)
+    ref_eng, ref_h, ref, _ = _run_engine(cfg, params, prompts, sps)
+    full = [np.concatenate([p, np.asarray(o, np.int32)])
+            for p, o in zip(prompts, ref)]
+
+    eng_hi, _, out_hi, _ = _run_engine(cfg, params, prompts, sps,
+                                       spec_k=4,
+                                       drafter=_OracleDrafter(full))
+    assert out_hi == ref
+    hi = eng_hi.stats()
+    assert hi["spec_tokens_accepted"] > hi["spec_tokens_drafted"] * 0.5
+
+    eng_lo, _, out_lo, _ = _run_engine(cfg, params, prompts, sps,
+                                       spec_k=4,
+                                       drafter=_WrongDrafter())
+    assert out_lo == ref
+    lo = eng_lo.stats()
+    assert lo["spec_tokens_accepted"] < lo["spec_tokens_drafted"] * 0.2
+
+
+class _SwitchableDrafter(Drafter):
+    def __init__(self):
+        self.inner = _WrongDrafter()
+
+    def propose(self, history, k):
+        return self.inner.propose(history, k)
+
+
+def test_zero_recompiles_across_acceptance_churn(model):
+    """Acceptance rate is DATA: one engine serving 0%-acceptance traffic,
+    then ~100%-acceptance traffic (drafter swapped mid-life), never
+    recompiles — the frozen watcher would report any signature change."""
+    cfg, params = model
+    prompts, sps = _mixed_requests(cfg)
+    _, _, ref, _ = _run_engine(cfg, params, prompts, sps)
+    full = [np.concatenate([p, np.asarray(o, np.int32)])
+            for p, o in zip(prompts, ref)]
+
+    drafter = _SwitchableDrafter()
+    eng = DecodeEngine(cfg, params, n_slots=2, max_queue=len(prompts),
+                       warmup_prompt_cap=16, spec_k=4, drafter=drafter)
+    eng.warmup()
+    assert all(w.frozen for w in eng._watchers())
+
+    handles = [eng.submit(p, sp, block=True)
+               for p, sp in zip(prompts, sps)]
+    eng.run_until_idle()
+    assert [list(h.output_ids) for h in handles] == ref
+    low = eng.stats()["spec_tokens_accepted"]
+
+    drafter.inner = _OracleDrafter(full)      # 0% -> ~100% mid-life
+    handles = [eng.submit(p, sp, block=True)
+               for p, sp in zip(prompts, sps)]
+    eng.run_until_idle()
+    assert [list(h.output_ids) for h in handles] == ref
+    assert eng.stats()["spec_tokens_accepted"] > low
+    assert eng.n_recompiles == 0
+
+
+def test_per_request_spec_optout(model):
+    """``SamplingParams(spec=False)`` rows ride the same verify program
+    committing one token per tick: identical tokens, zero drafted
+    tokens on their ledger, co-resident spec rows unaffected."""
+    cfg, params = model
+    prompts, sps = _mixed_requests(cfg, n=4)
+    sps = [sp if i % 2 else
+           SamplingParams(**dict(sp.__dict__, spec=False))
+           for i, sp in enumerate(sps)]
+    _, _, ref, _ = _run_engine(cfg, params, prompts, sps)
+    eng, handles, out, _ = _run_engine(cfg, params, prompts, sps,
+                                       spec_k=3)
+    assert out == ref
+    for i, h in enumerate(handles):
+        if i % 2 == 0:
+            assert h.spec_drafted == 0 and h.spec_accepted == 0
+            assert "spec_drafted" not in h.summary()
+        else:
+            assert h.spec_drafted > 0
+            assert h.summary()["spec_drafted"] == h.spec_drafted
+
+
+# ---------------------------------------------------------------------------
+# Composition: int8 KV, chunked prefill, capacity edge
+# ---------------------------------------------------------------------------
+
+def test_spec_composes_with_int8_kv(model):
+    """spec x int8: quantize-on-write covers the k+1 candidate panes;
+    tokens are bit-identical to the int8 spec-OFF engine (same appended
+    values => same codes/scales for every committed position)."""
+    cfg, params = model
+    prompts, sps = _mixed_requests(cfg)
+    pol = KVCachePolicy(kv_quant="int8")
+    _, _, ref, _ = _run_engine(cfg, params, prompts, sps, kv_policy=pol)
+    eng, _, out, _ = _run_engine(cfg, params, prompts, sps, spec_k=4,
+                                 kv_policy=KVCachePolicy(kv_quant="int8"))
+    assert out == ref
+    assert eng.n_recompiles == 0
+
+
+def test_spec_composes_with_chunked_prefill(model):
+    """spec x chunked prefill: mid-prefill slots ride the verify program
+    as ignored rows (their garbage appends land at the next chunk's
+    write offset exactly as in the plain decode tick); co-resident
+    outputs stay bit-identical to the chunked spec-off engine."""
+    cfg, params = model
+    prompts, sps = _mixed_requests(cfg, prompt_len=20)
+    pol = lambda: KVCachePolicy(prefill_chunk=8)  # noqa: E731
+    _, _, ref, _ = _run_engine(cfg, params, prompts, sps,
+                               kv_policy=pol())
+    eng, _, out, _ = _run_engine(cfg, params, prompts, sps, spec_k=4,
+                                 kv_policy=pol())
+    assert out == ref
+    assert eng.n_recompiles == 0
+
+
+def test_spec_composes_with_adapters(model, tmp_path):
+    """spec x multi-tenant LoRA: the verify program carries the adapter
+    pool exactly like the decode step (gathered per-row application over
+    all k+1 positions); mixed adapter+base traffic stays bit-identical
+    to the spec-off adapter engine with zero recompiles."""
+    from building_llm_from_scratch_tpu.models.lora import (
+        init_lora_params,
+        save_adapter,
+    )
+    from building_llm_from_scratch_tpu.serving import AdapterRegistry
+
+    cfg, params = model
+    lora = init_lora_params(cfg, params, jax.random.PRNGKey(5), rank=4)
+    lora = jax.tree_util.tree_map(lambda a: a + 0.02, lora)
+    art = str(tmp_path / "a.npz")
+    save_adapter(art, lora, rank=4, alpha=8, cfg=cfg)
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(2, cfg.vocab_size, (8,)).astype(np.int32)
+               for _ in range(4)]
+    sps = [SamplingParams(max_new_tokens=12, ignore_eos=True, seed=i,
+                          temperature=0.6 if i >= 2 else 0.0,
+                          top_k=8 if i >= 2 else None,
+                          adapter="a" if i % 2 else None)
+           for i in range(4)]
+
+    def run(spec_k):
+        reg = AdapterRegistry.from_artifacts(cfg, params, {"a": art})
+        eng = DecodeEngine(cfg, params, n_slots=2, max_queue=4,
+                           warmup_prompt_cap=16, adapters=reg,
+                           spec_k=spec_k)
+        eng.warmup()
+        hs = [eng.submit(p, sp, block=True)
+              for p, sp in zip(prompts, sps)]
+        eng.run_until_idle()
+        return [list(h.output_ids) for h in hs], eng.n_recompiles
+
+    ref, _ = run(0)
+    out, recompiles = run(4)
+    assert out == ref
+    assert recompiles == 0
+
+
+def test_near_capacity_rows_complete_with_parity(model):
+    """Regression: rows decoding at the slot-capacity edge. The verify
+    program's tail positions exceed context_length there — unclamped
+    they would index NaN positional rows (jnp.take OOB fill) and the
+    0*NaN value einsum poisoned the whole row into a non_finite_logits
+    retirement. Clamped, capacity-edge requests complete bit-identically
+    to spec-off."""
+    cfg, params = model
+    rng = np.random.default_rng(1)
+    max_len = 32
+    prompts = [rng.integers(2, cfg.vocab_size, (8,)).astype(np.int32)
+               for _ in range(3)]
+    sps = [SamplingParams(max_new_tokens=max_len - 8, ignore_eos=True,
+                          seed=i, temperature=0.5 if i == 2 else 0.0,
+                          top_k=8 if i == 2 else None)
+           for i in range(3)]
+    _, _, ref, ref_r = _run_engine(cfg, params, prompts, sps,
+                                   max_len=max_len)
+    assert ref_r == ["length"] * 3
+    eng, _, out, out_r = _run_engine(cfg, params, prompts, sps,
+                                     spec_k=4, max_len=max_len)
+    assert out_r == ["length"] * 3
+    assert out == ref
+    assert eng.n_recompiles == 0
+
+
+def test_spec_k_bounds_validated(model):
+    cfg, params = model
+    with pytest.raises(ValueError, match="spec_k"):
+        DecodeEngine(cfg, params, n_slots=1, spec_k=-1)
+    with pytest.raises(ValueError, match="spec_k"):
+        DecodeEngine(cfg, params, n_slots=1, max_len=8, spec_k=8)
+
+
+# ---------------------------------------------------------------------------
+# Telemetry
+# ---------------------------------------------------------------------------
+
+def test_acceptance_telemetry_lands_everywhere(model, tmp_path):
+    """request_done carries the per-request draft/accept ledger, cadence
+    metrics rows carry per-window drafted/accepted, /metrics exposes the
+    cumulative counters + acceptance-ratio gauge, and serve_warmup
+    records the spec config."""
+    from building_llm_from_scratch_tpu.obs.schema import validate_event
+
+    cfg, params = model
+    # the spec-off reference runs BEFORE the sink attaches — only the
+    # speculative engine's telemetry lands in the JSONL under test
+    prompts, sps = _mixed_requests(cfg)
+    _, _, ref, _ = _run_engine(cfg, params, prompts, sps)
+    full = [np.concatenate([p, np.asarray(o, np.int32)])
+            for p, o in zip(prompts, ref)]
+    jsonl = tmp_path / "metrics.jsonl"
+    configure_metrics(str(jsonl))
+    try:
+        eng = DecodeEngine(cfg, params, n_slots=2,
+                           max_queue=len(prompts), warmup_prompt_cap=16,
+                           spec_k=4, drafter=_OracleDrafter(full),
+                           metrics_every=2)
+        eng.warmup()
+        handles = [eng.submit(p, sp, block=True)
+                   for p, sp in zip(prompts, sps)]
+        eng.run_until_idle()
+        stats = eng.stats()
+        text = eng.prometheus_text()
+        eng.shutdown()
+    finally:
+        configure_metrics(None)
+
+    rows = [json.loads(line) for line in open(jsonl)]
+    warm = [r for r in rows if r.get("event") == "serve_warmup"]
+    assert warm[-1]["spec_k"] == 4
+    assert "drafter" in warm[-1]
+    done = [r for r in rows if r.get("event") == "request_done"]
+    assert len(done) == len(prompts)
+    assert all(r["spec_drafted"] > 0 for r in done)
+    assert sum(r["spec_accepted"] for r in done) > 0
+    for r in done:
+        fields = {k: v for k, v in r.items()
+                  if k not in ("type", "time", "event")}
+        assert validate_event("request_done", fields) == []
+    cadence = [r for r in rows if r.get("type") == "metrics"
+               and "spec_drafted" in r]
+    assert cadence and any(r["spec_accepted"] > 0 for r in cadence)
+    # stats + /metrics
+    assert stats["spec_tokens_drafted"] > 0
+    assert stats["spec_acceptance_ratio"] > 0.5
+    assert "bllm_serve_spec_tokens_drafted" in text
+    assert "bllm_serve_spec_acceptance_ratio" in text
+    # the draft phase is accounted (spec engines do host drafting work)
+    assert "bllm_serve_tick_draft_seconds" in text
