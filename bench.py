@@ -9,6 +9,8 @@ Usage:
   python bench.py cfg5       # LLaMA2-7B-arch zero1 slice (BASELINE #5, see note)
   python bench.py trainer    # Trainer-loop path (vs raw-step, VERDICT r2 #3)
   python bench.py serve      # continuous-batching engine vs sequential decode
+  python bench.py serve_fleet  # router replica sweep (1/2/4 replicas,
+                               # one forced-host device per replica)
   python bench.py micro_train  # debug-size perf-gate micro-bench (CI)
   python bench.py all        # everything, one JSON line each
 
@@ -686,6 +688,116 @@ def bench_serve_load(n_slots=4, max_new=24, prompt_len=16,
                    unit="tokens/sec", detail=detail)
 
 
+def bench_serve_fleet(max_new=24, prompt_len=16, n_slots=4,
+                      requests_per_replica=32, replica_counts=(1, 2, 4)):
+    """Replica-scaling sweep through the fleet router (serving/router.py):
+    the ``serve_load`` open-loop Poisson harness pointed at an
+    ``EngineRouter`` at 1/2/4 replicas, offered load scaled with the
+    replica count (per-replica capacity measured once by the 1-replica
+    arm). Each arm runs in a SUBPROCESS with
+    ``--xla_force_host_platform_device_count=8`` so every replica gets
+    its own CPU device — per-device execution threads are independent
+    and XLA releases the GIL, so this measures real concurrent replicas,
+    not time-slicing (scripts/bench_fleet_worker.py). Aggregate
+    completed-rps should scale near-linearly; the headline metric is the
+    2-replica aggregate tokens/sec, ``speedup_2x``/``speedup_4x`` ride
+    as extra metrics. This bench has no in-process fingerprint (the
+    programs compile in the workers) — ``micro_router`` structurally
+    gates the per-replica program family in CI instead."""
+    import subprocess
+
+    rpr, mnew = requests_per_replica, max_new
+    if _QUICK:
+        rpr, mnew = min(rpr, 8), min(mnew, 8)
+    repo = os.path.dirname(os.path.abspath(__file__))
+    worker = os.path.join(repo, "scripts", "bench_fleet_worker.py")
+    env = dict(os.environ, JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS",
+                                                        "cpu"))
+    # the worker imports the package from the repo root (running it by
+    # path puts scripts/ at sys.path[0], not the repo)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    detail = {}
+    cap_rps = 0.0
+    completed = {}
+    for r in replica_counts:
+        cmd = [sys.executable, worker, "--replicas", str(r),
+               "--cap_rps", str(cap_rps),
+               "--requests_per_replica", str(rpr),
+               "--max_new", str(mnew), "--prompt_len", str(prompt_len),
+               "--slots", str(n_slots), "--loads", "0.75,1.25"]
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=1800, env=env)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"fleet worker (replicas={r}) failed rc="
+                f"{proc.returncode}:\n{proc.stderr[-2000:]}")
+        row = json.loads(proc.stdout.strip().splitlines()[-1])
+        if cap_rps <= 0:
+            cap_rps = row["cap_rps"]
+            detail["capacity"] = row.get("capacity")
+        detail[f"replicas_{r}"] = row["arms"]
+        completed[r] = row["arms"]["load_1.25x"]["completed_rps"]
+    for r in replica_counts[1:]:
+        if completed.get(1):
+            detail[f"speedup_{r}x"] = round(completed[r] / completed[1], 3)
+    print(json.dumps(detail), flush=True)
+    res = _result("serve_fleet", "fleet aggregate tokens/sec GPT2-124M "
+                  f"router {len(replica_counts)}-arm sweep slots{n_slots} "
+                  "completed@1.25x 2-replicas",
+                  completed.get(2, completed[replica_counts[0]]) * mnew,
+                  unit="tokens/sec", detail=detail)
+    for r in replica_counts[1:]:
+        if f"speedup_{r}x" in detail:
+            res.add_metric(f"speedup_{r}x", detail[f"speedup_{r}x"],
+                           "ratio")
+    return res
+
+
+def bench_micro_router(n_replicas=2):
+    """Debug-size fleet router (2 replicas x 2 slots, 8 mixed requests):
+    the gate workload for the scale-out tier. ``watch_compiles="first"``
+    wraps only replica 0's programs, so the captured fingerprint is the
+    PER-REPLICA compiled-program family — replica-count invariant by
+    construction (a 3-replica router fingerprints identically,
+    test-pinned), while a change to what one replica compiles (router
+    construction altering cache placement, an extra program, a warmup
+    recompile) fails the structural gate with the program named."""
+    from building_llm_from_scratch_tpu.configs import get_config
+    from building_llm_from_scratch_tpu.models import init_params
+    from building_llm_from_scratch_tpu.serving import (
+        EngineRouter,
+        SamplingParams,
+    )
+
+    n_requests, max_new, prompt_len = 8, 4, 4
+    cfg = get_config("GPT2", "124M", dtype="fp32", debug=True)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(0, cfg.vocab_size,
+                           (n_requests, prompt_len)).astype(np.int32)
+    sp = SamplingParams(max_new_tokens=max_new, ignore_eos=True)
+    router = EngineRouter.build(cfg, params, n_replicas=n_replicas,
+                                n_slots=2, max_queue=n_requests,
+                                warmup_prompt_cap=prompt_len,
+                                metrics_every=2,
+                                watch_compiles="first")
+    router.warmup()
+    t0 = time.perf_counter()
+    handles = [router.submit(p, sp, block=True) for p in prompts]
+    router.run_until_idle()
+    dt = time.perf_counter() - t0
+    for h in handles:
+        assert len(h.output_ids) == max_new, h.finish_reason
+    detail = {"recompiles": router.n_recompiles,
+              "routed_total": router.routed_total}
+    router.shutdown()
+    return _result("micro_router", "fleet tokens/sec GPT2-debug fp32 "
+                   f"{n_requests}req x {max_new}new "
+                   f"{n_replicas}replicas x slots2",
+                   n_requests * max_new / dt, unit="tokens/sec",
+                   detail=detail)
+
+
 def bench_serve_lora(n_adapters=3, n_requests=16, max_new=24,
                      prompt_len=16, rank=8, n_slots=4):
     """Multi-tenant LoRA serving A/B (serving/adapters.py): the SAME
@@ -1330,6 +1442,7 @@ BENCHES = {
     "decode": bench_decode,
     "serve": bench_serve,
     "serve_load": bench_serve_load,
+    "serve_fleet": bench_serve_fleet,
     "serve_lora": bench_serve_lora,
     "serve_prefix": bench_serve_prefix,
     "serve_spec": bench_serve_spec,
@@ -1339,12 +1452,13 @@ BENCHES = {
     "micro_serve": bench_micro_serve,
     "micro_lora_fusion": bench_micro_lora_fusion,
     "micro_spec": bench_micro_spec,
+    "micro_router": bench_micro_router,
 }
 
 #: Micro-benches excluded from ``all`` (they are gate workloads, not
 #: performance claims — their tok/s on a debug model means nothing).
 MICRO_BENCHES = ("micro_train", "micro_accum", "micro_serve",
-                 "micro_lora_fusion", "micro_spec")
+                 "micro_lora_fusion", "micro_spec", "micro_router")
 
 
 def run_bench(name: str, repeats: int = 1, quick: bool = False

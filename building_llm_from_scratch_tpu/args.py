@@ -76,6 +76,11 @@ def perform_checks(args) -> None:
                 f"--serve_prompts '{args.serve_prompts}' does not exist.")
         if args.serve_slots < 1:
             raise ValueError("--serve_slots must be >= 1.")
+        if args.serve_replicas < 1:
+            raise ValueError("--serve_replicas must be >= 1.")
+        if args.serve_tp < 1:
+            raise ValueError("--serve_tp must be >= 1 (devices per "
+                             "replica; 1 = unsharded).")
         if args.serve_max_queue < 1:
             raise ValueError("--serve_max_queue must be >= 1.")
         if args.serve_max_new_tokens < 1:
@@ -140,7 +145,7 @@ def perform_checks(args) -> None:
             ("serve_adapters", None), ("serve_adapter_slots", 0),
             ("serve_prefix_cache", "off"), ("serve_prefill_chunk", 0),
             ("serve_kv_quant", "model"), ("serve_prefix_budget_mb", 256.0),
-            ("serve_spec_k", 0),
+            ("serve_spec_k", 0), ("serve_replicas", 1), ("serve_tp", 1),
         ) if getattr(args, name) != default]
         if stray:
             raise ValueError(
@@ -389,6 +394,23 @@ def get_args(argv=None):
                         help="Directory to save model checkpoints.")
 
     # Serving (--mode serve; serving/ package)
+    parser.add_argument("--serve_replicas", type=int, default=1,
+                        help="Scale-out serving (serving/router.py): run "
+                             "this many DecodeEngine replicas behind one "
+                             "router with deadline-aware dispatch, "
+                             "adapter-affinity + prefix-affinity routing "
+                             "and rolling drain. Each replica gets its "
+                             "own --serve_tp device slice (disjoint when "
+                             "the device pool allows) and its own "
+                             "adapter registry. 1 = the historical "
+                             "single-engine path (no router object).")
+    parser.add_argument("--serve_tp", type=int, default=1,
+                        help="Tensor-parallel degree per serving replica: "
+                             "the decode/prefill/verify program family "
+                             "runs with NamedSharding'd weights and "
+                             "heads-sharded slot KV over a (1,1,tp) "
+                             "mesh (Megatron rules, "
+                             "parallel/sharding.py). 1 = unsharded.")
     parser.add_argument("--serve_slots", type=int, default=8,
                         help="Decode slots: the fixed batch rows the "
                              "engine keeps full (one XLA decode program "

@@ -308,13 +308,23 @@ def render_prometheus(counters: Dict[str, float],
         emit(name, v, "gauge", counter=False)
     for name in sorted(histograms):
         snap = histograms[name].snapshot()
-        n = _prom_name(prefix + name)
-        lines.append(f"# TYPE {n} histogram")
+        # histogram keys may carry a label set too (the replica router
+        # re-exports each replica's histograms as ttft_seconds{replica=
+        # "i"}): labels merge INSIDE the _bucket/_sum/_count series per
+        # exposition grammar — ..._bucket{replica="i",le="0.1"}
+        base, _sep, labels = name.partition("{")
+        labels = labels[:-1] if labels else ""
+        n = _prom_name(prefix + base)
+        if n not in typed:
+            typed.add(n)
+            lines.append(f"# TYPE {n} histogram")
+        pre = labels + "," if labels else ""
+        suffix = "{" + labels + "}" if labels else ""
         for le, cum in snap["buckets"]:
             le_txt = "+Inf" if le == "+Inf" else repr(float(le))
-            lines.append(f'{n}_bucket{{le="{le_txt}"}} {cum}')
-        lines.append(f"{n}_sum {snap['sum']}")
-        lines.append(f"{n}_count {snap['count']}")
+            lines.append(f'{n}_bucket{{{pre}le="{le_txt}"}} {cum}')
+        lines.append(f"{n}_sum{suffix} {snap['sum']}")
+        lines.append(f"{n}_count{suffix} {snap['count']}")
     return "\n".join(lines) + "\n"
 
 
@@ -424,9 +434,12 @@ class MetricLogger:
         for b in buffered:
             self._write_row(b)
 
-    def log_metrics(self, step: int, **values: Any) -> None:
+    def log_metrics(self, step: int, monotonic: bool = True,
+                    **values: Any) -> None:
         """One ``metrics`` row; merges and drains the timing buckets and
-        attaches current counters/gauges."""
+        attaches current counters/gauges. ``monotonic=False`` skips the
+        step-regression warning — fleet-serving replicas interleave
+        their per-engine tick counters into one sink by design."""
         with self._lock:
             timings = {f"{k}_s": round(v, 6)
                        for k, v in self._timings.items()}
@@ -438,7 +451,7 @@ class MetricLogger:
         row.update(extra)
         row.update(values)
         with self._lock:
-            if step < self._last_step:
+            if monotonic and step < self._last_step:
                 logger.warning("Metrics row step went backwards (%d < %d)",
                                step, self._last_step)
             self._last_step = max(self._last_step, int(step))

@@ -35,12 +35,15 @@ from typing import Any, Dict, FrozenSet, List
 
 #: Bump when a row type or a load-bearing field changes meaning. The
 #: ``header`` row carries it; consumers key parsing decisions on it.
-SCHEMA_VERSION = 7          # v7: speculative decoding — `draft` tick
+SCHEMA_VERSION = 8          # v8: scale-out serving — serve_fleet /
+                            # replica_drain / replica_restart /
+                            # router_redispatch events, `replica` label
+                            # on engine-scoped events + span rows,
+                            # `router` request-span child
+                            # (v7: speculative decoding — `draft` tick
                             # phase, spec_drafted/spec_accepted on
                             # request_done + cadence rows, serve_warmup
-                            # grew spec_k/drafter
-                            # (v6: + finetune_job_*/finetune_fleet events,
-                            # adapter_save grew job_id)
+                            # grew spec_k/drafter)
 
 #: JSONL row discriminators (the ``type`` field).
 ROW_TYPES = ("header", "metrics", "health", "event", "span")
@@ -78,7 +81,9 @@ SERVING_LIFECYCLE_EVENTS = ("engine_restart", "drain", "serve_error")
 SPAN_NAMES = ("request",)
 
 #: Child span names under a ``request`` root, in lifecycle order.
-REQUEST_SPAN_PHASES = ("queued", "prefill", "decode")
+#: ``router`` (fleet dispatch hop, serving/router.py) only appears on
+#: routed requests — single-engine span trees are unchanged.
+REQUEST_SPAN_PHASES = ("router", "queued", "prefill", "decode")
 
 
 @dataclass(frozen=True)
@@ -176,22 +181,23 @@ _EVENT_LIST: List[EventSpec] = [
     _spec("request_done", required=("request_id",),
           optional=("n_prompt_tokens", "n_tokens", "finish_reason", "slot",
                     "deadline_s", "queue_wait_s", "ttft_s", "tpot_s",
-                    "e2e_s", "adapter", "spec_drafted", "spec_accepted"),
+                    "e2e_s", "adapter", "spec_drafted", "spec_accepted",
+                    "replica"),
           doc="one request completed normally (latency summary; "
               "spec_drafted/spec_accepted = this request's speculative "
               "acceptance ledger on --serve_spec_k engines)"),
     _spec("request_rejected", required=("request_id", "reason"),
-          optional=("queue_depth",),
+          optional=("queue_depth", "replica"),
           doc="bounded queue at capacity at submit (HTTP 429)"),
     _spec("request_shed", required=("request_id", "reason"),
           optional=("queue_depth", "deadline_s", "estimated_e2e_s",
-                    "retry_after_s"),
+                    "retry_after_s", "replica"),
           doc="SLO-predicted deadline miss rejected at submit"),
     _spec("request_expired", required=("request_id", "reason"),
-          optional=("deadline_s", "queue_wait_s", "queue_depth"),
+          optional=("deadline_s", "queue_wait_s", "queue_depth", "replica"),
           doc="deadline passed while queued (TTL shed, HTTP 504)"),
     _spec("request_failed", required=("request_id", "reason"),
-          optional=("error", "slot", "n_tokens", "adapter"),
+          optional=("error", "slot", "n_tokens", "adapter", "replica"),
           doc="one request failed in isolation (or engine death/restart)"),
     # -- serving: multi-tenant LoRA adapters ------------------------------
     _spec("adapter_save", required=("path",),
@@ -234,13 +240,13 @@ _EVENT_LIST: List[EventSpec] = [
     # -- serving: KV-cache memory engine ----------------------------------
     _spec("prefix_hit", required=("request_id",),
           optional=("span_tokens", "prompt_tokens", "key",
-                    "n_suffix_chunks", "adapter", "late"),
+                    "n_suffix_chunks", "adapter", "late", "replica"),
           doc="a stored prefix matched: its panes were copied into the "
               "slot (zero forward FLOPs for the cached span). late=True "
               "is the mid-prefill catch-up hit — a co-admitted sharer "
               "jumping ahead on a pane stored after its admission"),
     _spec("prefix_miss", required=("request_id",),
-          optional=("prompt_tokens", "adapter"),
+          optional=("prompt_tokens", "adapter", "replica"),
           doc="no stored prefix matched; the prompt prefills in full "
               "(and its chunk-aligned prefix is stored for successors)"),
     _spec("prefix_evict", required=("key",),
@@ -249,7 +255,7 @@ _EVENT_LIST: List[EventSpec] = [
           doc="LRU eviction under the prefix store's byte budget "
               "(pinned entries are never evicted)"),
     _spec("prefix_insert", required=("request_id",),
-          optional=("span_tokens", "bytes", "entries", "adapter"),
+          optional=("span_tokens", "bytes", "entries", "adapter", "replica"),
           doc="a completed prefill's chunk-aligned prefix pane entered "
               "the store"),
     # -- perf observatory -------------------------------------------------
@@ -265,7 +271,7 @@ _EVENT_LIST: List[EventSpec] = [
           optional=("n_prefill_buckets", "buckets", "seconds", "n_slots",
                     "max_len", "kv_quant", "prefix_cache", "prefill_chunk",
                     "kv_bytes_per_slot", "prefix_pane_tokens", "spec_k",
-                    "drafter"),
+                    "drafter", "replica"),
           doc="prefill programs + decode (or spec verify) program "
               "compiled; watchers frozen; records the KVCachePolicy "
               "(quant/chunk/prefix) and the speculative config "
@@ -273,16 +279,36 @@ _EVENT_LIST: List[EventSpec] = [
     _spec("serve_summary", open_fields=True,
           doc="shutdown stats snapshot (histogram percentiles, counters)"),
     _spec("serve_error", required=("error",),
-          optional=("n_failed", "failed_request_ids"),
+          optional=("n_failed", "failed_request_ids", "replica"),
           doc="engine died; every in-flight/queued request failed"),
     _spec("engine_restart", required=("reason",),
           optional=("detail", "n_restart", "max_restarts", "backoff_s",
                     "n_inflight_failed", "failed_request_ids",
-                    "queue_depth"),
+                    "queue_depth", "replica"),
           doc="supervisor abandoned a wedged loop and restarted it"),
+    # -- serving: fleet tier (serving/router.py) ---------------------------
+    _spec("serve_fleet", required=("phase",),
+          optional=("n_replicas", "tp", "disjoint_devices", "n_adapters",
+                    "seconds"),
+          doc="router lifecycle bracketing (phase: build|end): replica "
+              "count, tensor-parallel degree, whether replicas got "
+              "disjoint device slices"),
+    _spec("replica_drain", required=("replica", "phase"),
+          optional=("timeout_s", "n_active", "queue_depth",
+                    "n_redispatched", "n_preempted", "seconds"),
+          doc="one replica drained out of the fleet (phase: start|end); "
+              "its queued work re-dispatched onto live replicas"),
+    _spec("replica_restart", required=("replica",),
+          optional=("seconds",),
+          doc="a drained/dead replica re-entered dispatch as a fresh "
+              "engine (its own warmup compiles, then frozen watchers)"),
+    _spec("router_redispatch", required=("request_id",),
+          optional=("from_replica", "to_replica", "adapter"),
+          doc="one queued request moved between replicas during a "
+              "replica drain — same Request handle, zero client impact"),
     _spec("drain", required=("phase",),
           optional=("timeout_s", "n_active", "queue_depth", "n_preempted",
-                    "seconds", "requests_finished"),
+                    "seconds", "requests_finished", "replica"),
           doc="graceful drain bracketing events (phase: start|end)"),
 ]
 

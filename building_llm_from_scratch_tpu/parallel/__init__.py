@@ -15,6 +15,8 @@ from building_llm_from_scratch_tpu.parallel.sharding import (
     SHARD_MODES,
     MeshPlan,
     build_mesh_plan,
+    partition_serve_devices,
+    serve_mesh_plan,
 )
 from building_llm_from_scratch_tpu.parallel.pipeline import (
     PipelinePlan,
@@ -46,6 +48,8 @@ __all__ = [
     "SHARD_MODES",
     "MeshPlan",
     "build_mesh_plan",
+    "partition_serve_devices",
+    "serve_mesh_plan",
     "all_gather",
     "gather_full",
     "is_coordinator",

@@ -27,7 +27,7 @@ special cases). Stacked layer params (L, in, out) never shard the scan axis.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -195,6 +195,34 @@ class MeshPlan:
             return P(DATA_AXIS, SEQ_AXIS)
         return P(DATA_AXIS)
 
+    def cache_spec(self, shape: Tuple[int, ...]) -> P:
+        """PartitionSpec for a slot-KV cache leaf (serving tier).
+
+        Slot caches are per-layer ``(n_slots, Hkv, Tmax, hd)`` k/v panes
+        (int8 policies add ``(n_slots, Hkv, Tmax, 1)`` scale sidecars —
+        same rank, same rule). Under tensor parallelism the k/v
+        projections are column-parallel (``_TP_RULES`` shards their
+        output heads on ``model``), so the natural cache placement is
+        the HEADS axis on ``model`` — appends then write each device's
+        local heads with no resharding. Heads not divisible by the tp
+        degree (and non-4d leaves) replicate.
+        """
+        if self.shard_mode in ("tp", "tp_fsdp") and self.n_model > 1 \
+                and len(shape) == 4 and shape[1] % self.n_model == 0:
+            return P(None, MODEL_AXIS, None, None)
+        return P()
+
+    def shard_cache(self, cache: Params) -> Params:
+        """Place a slot-KV cache pytree on the mesh per ``cache_spec``."""
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(
+                x, self._named(self.cache_spec(tuple(x.shape)))), cache)
+
+    def put_replicated(self, x):
+        """Place one array replicated over this plan's mesh (adapter
+        pools and other small per-engine state that every shard reads)."""
+        return jax.device_put(x, self._named(P()))
+
     # -- pytree placement ---------------------------------------------
 
     def _named(self, spec: P) -> NamedSharding:
@@ -316,3 +344,40 @@ def build_mesh_plan(shard_mode: str = "dp", *, tp: int = 1, sp: int = 1,
     """Convenience: mesh spanning all devices + plan for ``shard_mode``."""
     mesh = make_mesh(data=-1, seq=sp, model=tp, devices=devices)
     return MeshPlan(mesh=mesh, shard_mode=shard_mode)
+
+
+def serve_mesh_plan(tp: int = 1, devices=None) -> MeshPlan:
+    """A serving-replica plan: ``(data=1, seq=1, model=tp)`` over exactly
+    ``tp`` devices. ``tp=1`` pins a replica to one device (the router's
+    replica-per-device layout); ``tp>1`` is the tensor-parallel engine
+    (Megatron rules over the ``model`` axis, slot KV sharded on heads)."""
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) < tp:
+        raise ValueError(
+            f"serve_mesh_plan(tp={tp}) needs {tp} devices, have "
+            f"{len(devices)}")
+    mesh = make_mesh(data=1, seq=1, model=tp, devices=devices[:tp])
+    return MeshPlan(mesh=mesh, shard_mode="tp" if tp > 1 else "dp")
+
+
+def partition_serve_devices(n_replicas: int, tp: int = 1,
+                            devices=None) -> List[List[jax.Device]]:
+    """Split the device pool into one device list per serving replica.
+
+    With enough devices every replica gets a DISJOINT ``tp``-device
+    slice (true scale-out: replicas execute concurrently). With fewer,
+    replicas round-robin over overlapping slices — correct but
+    device-serialized, which is still useful for tests and single-chip
+    smoke runs. ``tp`` greater than the pool is an error either way."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if tp > n:
+        raise ValueError(f"tp={tp} exceeds the {n} available devices")
+    out = []
+    for r in range(n_replicas):
+        if n >= n_replicas * tp:
+            lo = r * tp
+        else:
+            lo = (r * tp) % max(n - tp + 1, 1)
+        out.append(devices[lo: lo + tp])
+    return out

@@ -41,6 +41,7 @@ from building_llm_from_scratch_tpu.serving.request import (
     RequestExpiredError,
     SamplingParams,
 )
+from building_llm_from_scratch_tpu.serving.router import EngineRouter
 from building_llm_from_scratch_tpu.serving.scheduler import Scheduler
 from building_llm_from_scratch_tpu.serving.spec import (
     Drafter,
@@ -58,6 +59,7 @@ __all__ = [
     "DecodeEngine",
     "Drafter",
     "EngineDrainingError",
+    "EngineRouter",
     "EngineSupervisor",
     "FaultHooks",
     "KVCachePolicy",

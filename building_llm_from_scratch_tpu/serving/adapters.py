@@ -207,6 +207,20 @@ class AdapterRegistry:
 
     # -- engine wiring -----------------------------------------------------
 
+    def place_pool(self, put: Callable[[Any], Any]) -> None:
+        """Re-place the stacked pool through ``put`` (a ``device_put``
+        closure — e.g. ``MeshPlan.put_replicated``): a tensor-parallel
+        or device-pinned engine needs the pool on ITS mesh, or the
+        compiled programs would see arguments spanning two device sets.
+        Later ``load``/``evict`` updates are functional ``at[row].set``
+        on the placed arrays, so they inherit the placement."""
+        import jax
+
+        with self._lock:
+            pool, scaling = self._device
+            self._device = (jax.tree_util.tree_map(put, pool),
+                            put(scaling))
+
     def set_in_use_probe(self, fn: Callable[[], Set[int]]) -> None:
         """The engine's view of which pool rows active slots reference —
         ``load`` will not reuse those rows even after an evict, so

@@ -138,10 +138,27 @@ class DecodeEngine:
                  hooks: Optional[FaultHooks] = None,
                  adapters=None,
                  kv_policy: Optional[KVCachePolicy] = None,
-                 spec_k: int = 0, drafter=None):
+                 spec_k: int = 0, drafter=None,
+                 mesh_plan=None, replica: Optional[int] = None):
         import jax
 
         self.cfg = cfg
+        #: parallel/sharding.MeshPlan (or None = the historical
+        #: single-device engine, byte-for-byte). tp>1 runs the whole
+        #: prefill/decode/verify program family with NamedSharding'd
+        #: weights and heads-sharded slot KV over the ``model`` mesh
+        #: axis; tp=1 plans pin a replica to its own device (the
+        #: router's replica-per-device layout).
+        self.mesh_plan = mesh_plan
+        #: fleet position (serving/router.py): labels this engine's
+        #: telemetry events/metrics with ``replica=<i>``. None outside a
+        #: router — single-engine telemetry is unchanged.
+        self.replica = replica
+        if mesh_plan is not None:
+            # copy=False: the engine never donates params, so aliasing
+            # the caller's buffers is safe (and skips a full weight copy
+            # when build_components already placed them on this plan)
+            params = mesh_plan.shard_params(params, copy=False)
         self.params = params
         self.tokenizer = tokenizer
         #: serving/kvcache.KVCachePolicy — KV layout/dtype + prefix
@@ -197,10 +214,23 @@ class DecodeEngine:
 
         self.queue = RequestQueue(max_queue)
         self.scheduler = Scheduler(self.n_slots)
-        self.cache = init_slot_cache(
+        self.cache = self._place_cache(init_slot_cache(
             cfg, self.n_slots, self._cache_len,
-            policy=self.kv_policy)                      # guarded-by: _lock
-        self._blocks = unstack_blocks(params, cfg)
+            policy=self.kv_policy))                     # guarded-by: _lock
+        # pin the cache pytree's shardings for the life of the engine:
+        # every compiled program constrains its cache OUTPUT to these, so
+        # the donated rebind can never drift to a GSPMD-chosen layout
+        # that would change the next call's arg signature (a recompile)
+        self._cache_shardings = (jax.tree_util.tree_map(
+            lambda x: x.sharding, self.cache)
+            if mesh_plan is not None else None)
+        self._blocks = unstack_blocks(self.params, cfg)
+        if self.adapters is not None and mesh_plan is not None:
+            # the stacked pool rides every compiled call as data — it has
+            # to live on THIS engine's mesh (replicated: every model
+            # shard reads all adapter columns it needs), or jit would see
+            # arguments spanning two device sets
+            self.adapters.place_pool(mesh_plan.put_replicated)
         #: chunked-prefill progress per slot (slot -> host dict); a slot
         #: present here is ADMITTED but not yet decoding — the decode
         #: tick computes (and ignores) its row, and its next-write
@@ -380,6 +410,37 @@ class DecodeEngine:
         self._window_spec_drafted = 0                    # guarded-by: _lock
         self._window_spec_accepted = 0                   # guarded-by: _lock
 
+    # -- mesh placement (tp-sharded engine) --------------------------------
+
+    def _place_cache(self, cache):
+        """Place a fresh slot cache on the engine's mesh (identity for
+        planless engines — the historical allocation untouched)."""
+        if self.mesh_plan is None:
+            return cache
+        return self.mesh_plan.shard_cache(cache)
+
+    def _pin_cache(self, cache):
+        """In-graph sharding constraint pinning a program's cache OUTPUT
+        to the engine's fixed cache layout (no-op when planless). Keeps
+        the donate->rebind->call cycle signature-stable under GSPMD."""
+        if self._cache_shardings is None:
+            return cache
+        import jax
+
+        return jax.tree_util.tree_map(jax.lax.with_sharding_constraint,
+                                      cache, self._cache_shardings)
+
+    # -- telemetry ---------------------------------------------------------
+
+    def _ev(self, kind: str, **fields) -> None:
+        """Engine-scoped event: labels with this engine's fleet position
+        (``replica=<i>``) when it has one, so a router's merged JSONL
+        stays attributable per replica. Single engines emit the exact
+        historical rows (no replica field at all)."""
+        if self.replica is not None:
+            fields["replica"] = self.replica
+        get_metrics().event(kind, **fields)
+
     # -- jitted programs (close over params/cfg/blocks so per-tick call
     # signatures carry only the small mutable state + caches) -------------
 
@@ -403,7 +464,7 @@ class DecodeEngine:
         # stream garbage — the host retires the request with an error
         # status instead (scalar flag; adds one all-reduce over V)
         ok = jnp.all(jnp.isfinite(logits))
-        return tok, ok, cache
+        return tok, ok, self._pin_cache(cache)
 
     def _chunk_impl(self, cache, tokens, chunk_start, prompt_len, slot,
                     base_key, temp, topk, pool=None, pool_scale=None,
@@ -426,12 +487,12 @@ class DecodeEngine:
             logits[None], key0[None], jnp.reshape(temp, (1,)),
             jnp.reshape(topk, (1,)), self.max_top_k)[0]
         ok = jnp.all(jnp.isfinite(logits))
-        return tok, ok, cache
+        return tok, ok, self._pin_cache(cache)
 
     def _copy_impl(self, cache, panes, slot):
         """Prefix HIT: one batched DUS per layer writes the stored panes
         into row ``slot`` — the whole cached-span compute (no forward)."""
-        return copy_prefix_into_slot(cache, panes, slot)
+        return self._pin_cache(copy_prefix_into_slot(cache, panes, slot))
 
     def _decode_impl(self, cache, tokens, lengths, base_keys,
                      n_gen, temps, topks, pool=None, pool_scale=None,
@@ -453,7 +514,7 @@ class DecodeEngine:
         # poisoned row (bad KV state) goes non-finite ALONE — the host
         # retires just that slot (reason non_finite_logits)
         ok = jnp.all(jnp.isfinite(logits), axis=-1)
-        return nxt, ok, cache
+        return nxt, ok, self._pin_cache(cache)
 
     def _verify_impl(self, cache, tokens, lengths, base_keys,
                      n_gen, temps, topks, pool=None, pool_scale=None,
@@ -485,7 +546,7 @@ class DecodeEngine:
             base_keys, offsets)
         toks, n_acc, ok = accept_draft_tokens(
             logits, tokens[:, 1:], keys, temps, topks, self.max_top_k)
-        return toks, n_acc, ok, cache
+        return toks, n_acc, ok, self._pin_cache(cache)
 
     def _pool_args(self) -> tuple:
         """Positional tail for the compiled programs: the registry's
@@ -566,7 +627,7 @@ class DecodeEngine:
 
     def submit(self, prompt, params: Optional[SamplingParams] = None,
                block: bool = False, timeout: Optional[float] = None,
-               on_token=None) -> Request:
+               on_token=None, route: Optional[dict] = None) -> Request:
         """Enqueue one request (thread-safe). ``block=False`` rejects with
         ``QueueFullError`` when the bounded queue is at capacity;
         ``block=True`` waits for space (backpressure). Raises
@@ -637,6 +698,11 @@ class DecodeEngine:
         # a request_id on its event and close a span tree under that id,
         # or trace joins silently drop the requests that were turned away
         req = Request(next_request_id(), ids, params, on_token=on_token)
+        # router hop (serving/router.py): the dispatch decision precedes
+        # the Request's existence, so it arrives as data and lands on the
+        # span tree as a `router` child — even for requests turned away
+        # by the shed/queue-full decisions below
+        req.route = route
         if params.deadline_s is not None:
             # SLO-aware rejection: estimated completion = (queue position
             # / n_slots) x EWMA per-request service time + the request's
@@ -673,7 +739,7 @@ class DecodeEngine:
                 req.finish_reason = FINISH_SHED
                 req.state = REJECTED
                 req.t_finish = time.monotonic()
-                get_metrics().event(
+                self._ev(
                     "request_shed", request_id=req.id,
                     reason="slo_predicted_miss",
                     queue_depth=len(self.queue),
@@ -693,7 +759,7 @@ class DecodeEngine:
             req.t_finish = time.monotonic()
             with self._lock:                   # submit() is thread-safe
                 self.requests_rejected += 1
-            get_metrics().event("request_rejected", request_id=req.id,
+            self._ev("request_rejected", request_id=req.id,
                                 reason="queue_full",
                                 queue_depth=len(self.queue))
             self._emit_span(req)
@@ -727,6 +793,46 @@ class DecodeEngine:
             self._work.notify()
         return req
 
+    def adopt(self, req: Request, timeout: float = 5.0) -> None:
+        """Enqueue an EXISTING queued ``Request`` (the router's drain
+        re-dispatch: work stolen from a draining replica's queue moves to
+        a live one without the client's handle changing). BOUNDED
+        blocking backpressure: past ``timeout`` a full (or wedged-loop)
+        target raises ``QueueFullError`` so the re-dispatcher can fall
+        through to another replica — an unbounded wait here would hang
+        the whole rolling drain behind one stuck engine."""
+        if self._dead is not None:
+            raise RuntimeError(f"engine is dead: {self._dead}")
+        if self._draining:
+            raise EngineDrainingError(
+                "engine is draining: admission closed")
+        self.queue.put(req, block=True, timeout=timeout)
+        with self._work:
+            self._work.notify()
+
+    def service_snapshot(self) -> dict:
+        """Router-facing load/liveness snapshot (one per dispatch
+        decision). TIMED lock acquire: a wedged replica must never hang
+        fleet dispatch — on timeout the lock-free attr reads are stale
+        but safe (worst case one misrouted request, which the target's
+        own admission stack still protects)."""
+        lock = self._lock
+        locked = lock.acquire(timeout=0.2)
+        try:
+            return {
+                "queue_depth": len(self.queue),
+                "queue_capacity": self.queue.max_size,
+                "n_active": self.scheduler.n_active,
+                "n_slots": self.n_slots,
+                "tpot_ewma": self._tpot_ewma,
+                "tokens_ewma": self._tokens_ewma,
+                "draining": self._draining,
+                "dead": self._dead is not None,
+            }
+        finally:
+            if locked:
+                lock.release()
+
     # -- SLO service estimate ---------------------------------------------
 
     # holds: _lock
@@ -739,23 +845,22 @@ class DecodeEngine:
         decode budget at the EWMA TPOT. Without the in-flight term a
         full-slots/empty-queue engine would predict zero wait and admit
         requests straight into a TTL expiry. None until at least one
-        request has finished (no history — admission stays optimistic)."""
-        if self._tpot_ewma is None or self._tokens_ewma is None:
-            return None
-        per_request = self._tokens_ewma * self._tpot_ewma
-        backlog = queue_depth + 0.5 * self.scheduler.n_active
-        wait = (backlog / max(self.n_slots, 1)) * per_request
-        return wait + max_new_tokens * self._tpot_ewma
+        request has finished (no history — admission stays optimistic).
+        The math itself lives in module-level ``service_estimate`` — the
+        router's fleet dispatch computes the SAME estimate from replica
+        snapshots, and the two deciding differently about "predicted
+        miss" would route requests into immediate sheds."""
+        return service_estimate(queue_depth, self.scheduler.n_active,
+                                self.n_slots, self._tpot_ewma,
+                                self._tokens_ewma, max_new_tokens)
 
     # holds: _lock
     def estimate_queue_clear_s(self) -> Optional[float]:
         """Rough seconds until the current backlog drains (Retry-After
         material for 429/503 responses)."""
-        if self._tpot_ewma is None or self._tokens_ewma is None:
-            return None
-        per_request = self._tokens_ewma * self._tpot_ewma
-        backlog = len(self.queue) + self.scheduler.n_active
-        return round((backlog / max(self.n_slots, 1)) * per_request, 3)
+        return queue_clear_estimate(len(self.queue),
+                                    self.scheduler.n_active, self.n_slots,
+                                    self._tpot_ewma, self._tokens_ewma)
 
     # holds: _lock
     def _observe_service_time(self, req: Request) -> None:
@@ -793,7 +898,7 @@ class DecodeEngine:
             req.finish_reason = FINISH_EXPIRED
             req.state = FINISHED
             req.t_finish = time.monotonic()
-            get_metrics().event("request_expired", request_id=req.id,
+            self._ev("request_expired", request_id=req.id,
                                 reason="deadline_expired",
                                 deadline_s=req.params.deadline_s,
                                 queue_wait_s=round(waited, 4),
@@ -930,7 +1035,7 @@ class DecodeEngine:
                 pos = span
             else:
                 self._window_prefix_misses += 1
-                get_metrics().event("prefix_miss", request_id=req.id,
+                self._ev("prefix_miss", request_id=req.id,
                                     prompt_tokens=Tp,
                                     adapter=req.params.adapter)
         req.state = RUNNING
@@ -973,7 +1078,7 @@ class DecodeEngine:
         self._window_prefix_hits += 1
         self._tick_add("prefix_copy", time.perf_counter() - t_cp)
         Tp = int(req.prompt_ids.size)   # graft-ok: GL011 host numpy size
-        get_metrics().event(
+        self._ev(
             "prefix_hit", request_id=req.id, span_tokens=span,
             prompt_tokens=Tp, key=entry.key, late=late,
             n_suffix_chunks=-(-(Tp - span)
@@ -1091,7 +1196,7 @@ class DecodeEngine:
             return
         nbytes = self.prefix_store.insert(prefix_ids, tag, panes)
         if nbytes:
-            get_metrics().event(
+            self._ev(
                 "prefix_insert", request_id=req.id, span_tokens=span,
                 bytes=nbytes, entries=self.prefix_store.n_entries,
                 adapter=req.params.adapter)
@@ -1112,6 +1217,13 @@ class DecodeEngine:
                 return layer
             host = np.asarray(layer).copy()
             host[slot] = np.nan
+            if self.mesh_plan is not None:
+                # keep the pinned cache sharding: a default-device
+                # rebuild would change the compiled programs' arg
+                # signature (a recompile) on a mesh-placed engine
+                import jax
+
+                return jax.device_put(host, layer.sharding)
             return jnp.asarray(host)
 
         self.cache = {name: [nan_row(buf) for buf in bufs]
@@ -1511,7 +1623,7 @@ class DecodeEngine:
             # disconnect storms fire the burn-rate alert on a server
             # that met every deadline it was actually asked to meet
             self.slo_window.observe(miss=True)
-        get_metrics().event("request_failed", request_id=req.id,
+        self._ev("request_failed", request_id=req.id,
                             reason=reason, error=msg, slot=slot,
                             n_tokens=len(req.output_ids),
                             adapter=req.params.adapter)
@@ -1546,7 +1658,7 @@ class DecodeEngine:
             e2e = req.e2e_s() or 0.0
             self.slo_window.observe(miss=e2e > req.params.deadline_s)
         sink = get_metrics()
-        sink.event("request_done", **req.summary())
+        self._ev("request_done", **req.summary())
         self._emit_span(req)
         sink.gauge("slot_occupancy", self.scheduler.occupancy())
         sink.gauge("queue_depth", len(self.queue))
@@ -1583,7 +1695,9 @@ class DecodeEngine:
         if self.spec_k:
             kv["spec_drafted"] = self._window_spec_drafted
             kv["spec_accepted"] = self._window_spec_accepted
-        sink.log_metrics(self.n_ticks,
+        fleet = ({"replica": self.replica, "monotonic": False}
+                 if self.replica is not None else {})
+        sink.log_metrics(self.n_ticks, **fleet,
                          serve_tok_s=round(self._window_tokens / dt, 2),
                          requests_finished=self.requests_finished,
                          tokens_generated=self.tokens_generated,
@@ -1690,7 +1804,7 @@ class DecodeEngine:
         spec_fields = ({"spec_k": self.spec_k,
                         "drafter": self.drafter.describe()}
                        if self.spec_k else {})
-        get_metrics().event(
+        self._ev(
             "serve_warmup", n_prefill_buckets=len(buckets),
             buckets=buckets, seconds=round(time.monotonic() - t0, 3),
             n_slots=self.n_slots, max_len=self.max_len,
@@ -1820,11 +1934,11 @@ class DecodeEngine:
                 # frozen compiled programs accept it without recompiling.
                 # The prefix store survives: its panes are independent
                 # device arrays a wedged tick can't have corrupted.
-                self.cache = init_slot_cache(self.cfg, self.n_slots,
-                                             self._cache_len,
-                                             policy=self.kv_policy)
+                self.cache = self._place_cache(init_slot_cache(
+                    self.cfg, self.n_slots, self._cache_len,
+                    policy=self.kv_policy))
             backoff = self.restart_backoff_s * (2.0 ** (n_restart - 1))
-            get_metrics().event(
+            self._ev(
                 "engine_restart", reason=reason, detail=detail,
                 n_restart=n_restart, max_restarts=self.max_restarts,
                 backoff_s=round(backoff, 3), n_inflight_failed=failed,
@@ -1873,7 +1987,7 @@ class DecodeEngine:
                 req.state = FINISHED
                 req.t_finish = time.monotonic()
                 self.requests_failed += 1
-                get_metrics().event("request_failed", request_id=req.id,
+                self._ev("request_failed", request_id=req.id,
                                     reason="engine_dead", error=msg,
                                     slot=slot,
                                     n_tokens=len(req.output_ids))
@@ -1891,7 +2005,7 @@ class DecodeEngine:
                     break
                 _kill(req)
                 failed += 1
-            get_metrics().event("serve_error", error=msg, n_failed=failed,
+            self._ev("serve_error", error=msg, n_failed=failed,
                                 failed_request_ids=failed_ids)
         finally:
             if locked:
@@ -1952,7 +2066,7 @@ class DecodeEngine:
         # a bool store is atomic and readers re-check under real barriers
         self._draining = True                  # graft-ok: GL031 wedge-safe
         if not already:
-            get_metrics().event(
+            self._ev(
                 "drain", phase="start", timeout_s=timeout,
                 n_active=self.scheduler.n_active,
                 queue_depth=len(self.queue))
@@ -2019,7 +2133,7 @@ class DecodeEngine:
         summary = {"phase": "end", "n_preempted": preempted,
                    "seconds": round(time.monotonic() - t0, 3),
                    "requests_finished": self.requests_finished}
-        get_metrics().event("drain", **summary)
+        self._ev("drain", **summary)
         logger.warning("Drain complete in %.2fs (%d preempted).",
                        summary["seconds"], preempted)
         return summary
@@ -2042,7 +2156,7 @@ class DecodeEngine:
             self.run_until_idle()
         if self.supervisor is not None:
             self.supervisor.stop()
-        get_metrics().event("serve_summary", **self.stats())
+        self._ev("serve_summary", **self.stats())
 
     def stats(self) -> dict:
         with self._lock:                       # vs a mid-tick _finish()
@@ -2092,6 +2206,11 @@ class DecodeEngine:
 
     def uptime_s(self) -> float:
         return time.monotonic() - self._t_start_mono
+
+    def queue_capacity(self) -> int:
+        """Bounded-queue capacity (the 429 payload field) — a method so
+        the HTTP frontend reads one surface for engine AND router."""
+        return self.queue.max_size
 
     def metrics_snapshot(self) -> tuple:
         """(counters, gauges, histograms) for the ``/metrics`` exporter
@@ -2197,6 +2316,66 @@ class DecodeEngine:
         counters, gauges, hists = self.metrics_snapshot()
         return render_prometheus(counters, gauges, hists,
                                  prefix="bllm_serve_")
+
+    def healthz_payload(self) -> dict:
+        """The ``GET /healthz`` body — one method so the single-engine
+        frontend and the router's per-replica fleet view can't drift."""
+        if self._dead is not None:
+            status = "dead"
+        elif self.draining:
+            status = "draining"
+        else:
+            status = "serving"
+        counters, gauges, _ = self.metrics_snapshot()
+        return {
+            # original fields (kept for compatibility)
+            "status": status,
+            "slots": self.n_slots,
+            "active": self.scheduler.n_active,
+            "queue_depth": len(self.queue),
+            "queue_capacity": self.queue.max_size,
+            "warmed_up": self.warmed_up,
+            "draining": self.draining,
+            "restarts": self.n_restarts,
+            # structured snapshot (one probe answers "how is it
+            # doing", not just "is it up")
+            "uptime_s": round(self.uptime_s(), 3),
+            "n_ticks": counters["engine_ticks"],
+            "occupancy": self.scheduler.occupancy(),
+            "slo_miss_ratio": gauges.get("slo_miss_ratio"),
+            "counters": counters,
+        }
+
+
+def service_estimate(queue_depth: int, n_active: int, n_slots: int,
+                     tpot_ewma: Optional[float],
+                     tokens_ewma: Optional[float],
+                     max_new_tokens: int) -> Optional[float]:
+    """THE SLO completion estimate (pure): predicted submit->finish
+    seconds given a backlog and the live service EWMAs. Shared by
+    ``DecodeEngine.estimate_completion_s`` (per-engine shed) and the
+    fleet router's dispatch scoring — one formula, so fleet admission
+    and per-engine shed can never disagree on what a predicted miss is.
+    None without service history (admission stays optimistic)."""
+    if tpot_ewma is None or tokens_ewma is None:
+        return None
+    per_request = tokens_ewma * tpot_ewma
+    backlog = queue_depth + 0.5 * n_active
+    wait = (backlog / max(n_slots, 1)) * per_request
+    return wait + max_new_tokens * tpot_ewma
+
+
+def queue_clear_estimate(queue_depth: int, n_active: int, n_slots: int,
+                         tpot_ewma: Optional[float],
+                         tokens_ewma: Optional[float]
+                         ) -> Optional[float]:
+    """Rough seconds until a backlog drains (Retry-After material) —
+    the pure sibling of ``service_estimate``, shared with the router."""
+    if tpot_ewma is None or tokens_ewma is None:
+        return None
+    per_request = tokens_ewma * tpot_ewma
+    backlog = queue_depth + n_active
+    return round((backlog / max(n_slots, 1)) * per_request, 3)
 
 
 def _prng_key(seed: int):

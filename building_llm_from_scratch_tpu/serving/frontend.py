@@ -235,31 +235,10 @@ def make_http_server(engine: DecodeEngine, port: int,
                 return
             if self.path != "/healthz":
                 return self._json(404, {"error": "unknown path"})
-            if engine._dead is not None:
-                status = "dead"
-            elif engine.draining:
-                status = "draining"
-            else:
-                status = "serving"
-            counters, gauges, _ = engine.metrics_snapshot()
-            self._json(200, {
-                # original fields (kept for compatibility)
-                "status": status,
-                "slots": engine.n_slots,
-                "active": engine.scheduler.n_active,
-                "queue_depth": len(engine.queue),
-                "queue_capacity": engine.queue.max_size,
-                "warmed_up": engine.warmed_up,
-                "draining": engine.draining,
-                "restarts": engine.n_restarts,
-                # structured snapshot (one probe answers "how is it
-                # doing", not just "is it up")
-                "uptime_s": round(engine.uptime_s(), 3),
-                "n_ticks": engine.n_ticks,
-                "occupancy": engine.scheduler.occupancy(),
-                "slo_miss_ratio": gauges.get("slo_miss_ratio"),
-                "counters": counters,
-            })
+            # one method for both binds: a DecodeEngine answers its
+            # historical structured snapshot, an EngineRouter answers
+            # the fleet view (per-replica status + routing counters)
+            self._json(200, engine.healthz_payload())
 
         def do_POST(self):
             if self.path != "/generate":
@@ -303,7 +282,7 @@ def make_http_server(engine: DecodeEngine, port: int,
             except QueueFullError:
                 return self._json(429, {
                     "error": "request queue full — retry later",
-                    "queue_capacity": engine.queue.max_size},
+                    "queue_capacity": engine.queue_capacity()},
                     retry_after=engine.estimate_queue_clear_s() or 1.0)
             except ValueError as e:
                 return self._json(400, {"error": str(e)})
@@ -351,15 +330,81 @@ def run_serve(args, comps, metric_logger) -> DecodeEngine:
     ``comps``/``metric_logger`` come from main.py's shared bootstrap
     (metrics sink + compile cache + build_components + run-metadata
     header) so serve telemetry can't diverge from training telemetry.
-    Returns the (shut-down) engine for callers/tests.
+    Returns the (shut-down) engine for callers/tests — an
+    ``EngineRouter`` when ``--serve_replicas > 1``.
 
     Resilience wiring: SIGTERM/SIGINT trigger a graceful drain
-    (``--drain_timeout``); ``--serve_tick_timeout`` arms the fault
+    (``--drain_timeout``; rolling per replica in router mode, with
+    queued work re-dispatched); ``--serve_tick_timeout`` arms the fault
     supervisor (hung-tick flight record + bounded-backoff restart);
     ``--stall_timeout`` alone arms just the flight recorder."""
-    from building_llm_from_scratch_tpu.training.resilience import (
-        GracefulStopper,
+    from building_llm_from_scratch_tpu.serving.kvcache import KVCachePolicy
+
+    prefix_on = getattr(args, "serve_prefix_cache", "off") == "on"
+    chunk = getattr(args, "serve_prefill_chunk", 0)
+    if prefix_on and chunk <= 0:
+        chunk = 64          # prefix caching implies chunked prefill
+        logger.info("--serve_prefix_cache on: defaulting "
+                    "--serve_prefill_chunk to 64.")
+    kv_policy = KVCachePolicy(
+        kv_quant=getattr(args, "serve_kv_quant", "model"),
+        prefix_cache=prefix_on,
+        prefill_chunk=chunk,
+        prefix_budget_bytes=int(
+            getattr(args, "serve_prefix_budget_mb", 256.0) * 1024 ** 2),
     )
+    n_replicas = getattr(args, "serve_replicas", 1)
+    serve_tp = getattr(args, "serve_tp", 1)
+    if n_replicas > 1:
+        # fleet tier (serving/router.py): N engine replicas — each on
+        # its own mesh plan (tp devices apiece, disjoint when the pool
+        # allows) with its own adapter registry — behind one router
+        # surface. The frontends below bind the router exactly like an
+        # engine. The 1-replica branch stays the historical path: no
+        # router object exists there at all.
+        from building_llm_from_scratch_tpu.serving.router import (
+            EngineRouter,
+        )
+
+        specs = (parse_adapter_specs(args.serve_adapters)
+                 if getattr(args, "serve_adapters", None) else None)
+        engine = EngineRouter.build(
+            comps.cfg, comps.params, comps.tokenizer,
+            n_replicas=n_replicas, tp=serve_tp,
+            adapter_specs=specs,
+            adapter_capacity=args.serve_adapter_slots,
+            kv_policy=kv_policy,
+            n_slots=args.serve_slots,
+            max_len=(args.serve_max_len or None),
+            max_queue=args.serve_max_queue,
+            max_top_k=args.serve_max_top_k,
+            default_max_new_tokens=args.serve_max_new_tokens,
+            default_deadline_s=(args.serve_deadline_s or None),
+            tick_timeout_s=args.serve_tick_timeout,
+            max_restarts=args.serve_max_restarts,
+            metrics_every=args.serve_metrics_every,
+            spec_k=getattr(args, "serve_spec_k", 0),
+        )
+        stalls = []
+        if args.stall_timeout > 0:
+            # same semantics as the single-engine path: without the full
+            # supervisor, each replica gets its OWN flight recorder (a
+            # shared one would stay silent while healthy replicas tick
+            # past a wedged one)
+            from building_llm_from_scratch_tpu.serving.supervisor import (
+                make_serve_stall_detector,
+            )
+
+            for rep in engine.engines:
+                if rep.supervisor is None:
+                    det = make_serve_stall_detector(args.stall_timeout)
+                    rep.set_heartbeat(det.notify_step)
+                    stalls.append(det)
+        engine.warmup()
+        engine.start()
+        for det in stalls:
+            det.start()
+        return _serve_frontends(args, engine, stalls, metric_logger)
 
     adapters = None
     if getattr(args, "serve_adapters", None):
@@ -378,21 +423,16 @@ def run_serve(args, comps, metric_logger) -> DecodeEngine:
                     "capacity %d.", adapters.n_loaded,
                     ", ".join(adapters.names()), adapters.capacity)
 
-    from building_llm_from_scratch_tpu.serving.kvcache import KVCachePolicy
+    mesh_plan = None
+    if serve_tp > 1:
+        # single tp-sharded replica: the whole compiled program family
+        # runs with NamedSharding'd weights + heads-sharded slot KV over
+        # the `model` mesh axis (parallel/sharding.serve_mesh_plan)
+        from building_llm_from_scratch_tpu.parallel.sharding import (
+            serve_mesh_plan,
+        )
 
-    prefix_on = getattr(args, "serve_prefix_cache", "off") == "on"
-    chunk = getattr(args, "serve_prefill_chunk", 0)
-    if prefix_on and chunk <= 0:
-        chunk = 64          # prefix caching implies chunked prefill
-        logger.info("--serve_prefix_cache on: defaulting "
-                    "--serve_prefill_chunk to 64.")
-    kv_policy = KVCachePolicy(
-        kv_quant=getattr(args, "serve_kv_quant", "model"),
-        prefix_cache=prefix_on,
-        prefill_chunk=chunk,
-        prefix_budget_bytes=int(
-            getattr(args, "serve_prefix_budget_mb", 256.0) * 1024 ** 2),
-    )
+        mesh_plan = serve_mesh_plan(serve_tp)
     engine = DecodeEngine(
         comps.cfg, comps.params, comps.tokenizer,
         n_slots=args.serve_slots,
@@ -407,6 +447,7 @@ def run_serve(args, comps, metric_logger) -> DecodeEngine:
         adapters=adapters,
         kv_policy=kv_policy,
         spec_k=getattr(args, "serve_spec_k", 0),
+        mesh_plan=mesh_plan,
     )
     stall = None
     if args.stall_timeout > 0 and engine.supervisor is None:
@@ -423,6 +464,20 @@ def run_serve(args, comps, metric_logger) -> DecodeEngine:
     engine.start()
     if stall is not None:
         stall.start()
+    return _serve_frontends(args, engine,
+                            [stall] if stall is not None else [],
+                            metric_logger)
+
+
+def _serve_frontends(args, engine, stalls, metric_logger):
+    """Drive the frontends (JSONL pump and/or HTTP) + signal-drain wiring
+    over one warmed, started ``engine`` — a ``DecodeEngine`` or an
+    ``EngineRouter``; both expose the surface this loop needs (submit/
+    drain/shutdown/draining/healthz/metrics). ``stalls``: already-started
+    flight recorders to stop on exit (one per replica in router mode)."""
+    from building_llm_from_scratch_tpu.training.resilience import (
+        GracefulStopper,
+    )
 
     server = (make_http_server(engine, args.serve_port,
                                host=args.serve_host)
@@ -471,7 +526,7 @@ def run_serve(args, comps, metric_logger) -> DecodeEngine:
         if stopper.requested and not engine.draining:
             engine.drain(timeout=args.drain_timeout)
         engine.shutdown()
-        if stall is not None:
-            stall.stop()
+        for det in stalls:
+            det.stop()
         metric_logger.close()
     return engine

@@ -101,6 +101,12 @@ class Request:
         self.slot: Optional[int] = None
         self.error: Optional[str] = None
         self._cancelled = False  # client gave up; retired at next boundary
+        # router dispatch record (serving/router.py): {"replica": i,
+        # "affinity": "adapter"|"prefix"|None, "route_s": seconds} — set
+        # by the engine at submit (the decision precedes the Request's
+        # existence), updated on a drain re-dispatch. None outside a
+        # router: single-engine requests are unchanged.
+        self.route: Optional[dict] = None
         # speculative-decoding ledger (spec engines only): drafted = k per
         # decode tick; accepted = the in-graph accepted-draft count
         self.spec_drafted = 0
@@ -203,6 +209,8 @@ class Request:
             out["deadline_s"] = self.params.deadline_s
         if self.params.adapter is not None:
             out["adapter"] = self.params.adapter
+        if self.route is not None:
+            out["replica"] = self.route.get("replica")
         if self.spec_drafted:
             # acceptance telemetry (ISSUE 14): how much of this request's
             # decode the drafter paid for
@@ -238,9 +246,17 @@ class Request:
         request, whatever its outcome."""
         t_end = self.t_finish if self.t_finish is not None else (
             time.monotonic())
-        children = [{"name": "queued", "t0": self.wall_submit,
-                     "dur_s": (self.t_admit if self.t_admit is not None
-                               else t_end) - self.t_submit}]
+        children = []
+        if self.route is not None:
+            # the router hop: the dispatch decision's wall time, pinned
+            # at the root's start (the decision strictly precedes the
+            # Request, so its duration is data on the route record)
+            children.append({"name": "router", "t0": self.wall_submit,
+                             "dur_s": max(float(
+                                 self.route.get("route_s") or 0.0), 0.0)})
+        children.append({"name": "queued", "t0": self.wall_submit,
+                         "dur_s": (self.t_admit if self.t_admit is not None
+                                   else t_end) - self.t_submit})
         if self.t_admit is not None:
             t_ft = (self.t_first_token if self.t_first_token is not None
                     else min(t_end, self.t_admit))
@@ -266,6 +282,10 @@ class Request:
             row["slot"] = self.slot
         if self.params.adapter is not None:
             row["adapter"] = self.params.adapter
+        if self.route is not None:
+            row["replica"] = self.route.get("replica")
+            if self.route.get("affinity"):
+                row["affinity"] = self.route["affinity"]
         if self.error is not None:
             row["error"] = self.error
         return row

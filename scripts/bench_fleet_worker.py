@@ -1,0 +1,150 @@
+"""Worker process for ``bench.py serve_fleet`` (one replica-count arm).
+
+Runs an open-loop Poisson offered-load sweep against an ``EngineRouter``
+with ``--replicas`` engine replicas, each pinned to its OWN forced-host
+CPU device (``--xla_force_host_platform_device_count``, set HERE before
+jax imports — which is why this is a subprocess: the parent bench
+process's device count is pinned by the perf-gate baselines). Replicas
+execute concurrently (XLA releases the GIL; per-device execution threads
+are independent), so aggregate completed-throughput scales with the
+replica count — the curve this worker measures.
+
+Prints ONE JSON line: capacity (measured when ``--cap_rps 0``), and per
+offered-load arm the offered/completed rps, shed/rejected counts and
+TTFT/TPOT/e2e percentiles.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--replicas", type=int, required=True)
+    ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--cap_rps", type=float, default=0.0,
+                    help="single-replica capacity (requests/sec) measured "
+                         "by the replicas=1 arm; 0 = measure it here")
+    ap.add_argument("--requests_per_replica", type=int, default=32)
+    ap.add_argument("--max_new", type=int, default=24)
+    ap.add_argument("--prompt_len", type=int, default=16)
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--loads", type=str, default="0.75,1.25")
+    args = ap.parse_args()
+
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={args.devices}").strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import time
+
+    import jax
+    import numpy as np
+
+    from building_llm_from_scratch_tpu.configs import get_config
+    from building_llm_from_scratch_tpu.generate import _bucket
+    from building_llm_from_scratch_tpu.models import init_params
+    from building_llm_from_scratch_tpu.serving import (
+        EngineRouter,
+        QueueFullError,
+        SLOShedError,
+        SamplingParams,
+    )
+
+    dtype = "bf16" if jax.default_backend() == "tpu" else "fp32"
+    cfg = get_config("GPT2", "124M", dtype=dtype)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    R = args.replicas
+    n_requests = args.requests_per_replica * R
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(0, cfg.vocab_size,
+                           (n_requests, args.prompt_len)).astype(np.int32)
+
+    def new_router():
+        r = EngineRouter.build(
+            cfg, params, n_replicas=R, tp=args.tp,
+            n_slots=args.slots,
+            max_len=_bucket(args.prompt_len + args.max_new),
+            max_queue=max(2 * args.slots, 16),
+            warmup_prompt_cap=args.prompt_len, metrics_every=8)
+        r.warmup()
+        return r
+
+    out = {"replicas": R, "tp": args.tp,
+           "devices": jax.device_count(), "arms": {}}
+
+    cap_rps = args.cap_rps
+    if cap_rps <= 0:
+        # closed-loop single-replica capacity: one replica's slots
+        # decoded flat out — the per-replica saturation point every
+        # arm's offered load is expressed against
+        router = new_router()
+        eng = router.engines[0]
+        sp = SamplingParams(max_new_tokens=args.max_new, ignore_eos=True)
+        t0 = time.perf_counter()
+        for p in prompts[: args.slots]:
+            eng.submit(p, sp, block=True)
+        eng.run_until_idle()
+        cap_tok_s = (args.slots * args.max_new
+                     / (time.perf_counter() - t0))
+        cap_rps = cap_tok_s / args.max_new
+        out["capacity"] = {"tok_s": round(cap_tok_s, 1),
+                           "rps": round(cap_rps, 4)}
+        router.shutdown()
+    out["cap_rps"] = round(cap_rps, 4)
+
+    for load in (float(x) for x in args.loads.split(",")):
+        lam = load * cap_rps * R             # offered vs FLEET capacity
+        arrivals = np.cumsum(rng.exponential(1.0 / lam, n_requests))
+        router = new_router()
+        router.start()
+        handles, shed, rejected = [], 0, 0
+        t0 = time.perf_counter()
+        for i, (p, at) in enumerate(zip(prompts, arrivals)):
+            delay = at - (time.perf_counter() - t0)
+            if delay > 0:
+                time.sleep(delay)
+            try:
+                handles.append(router.submit(p, SamplingParams(
+                    max_new_tokens=args.max_new, ignore_eos=True,
+                    seed=i)))
+            except SLOShedError:
+                shed += 1
+            except QueueFullError:
+                rejected += 1
+        done = 0
+        for h in handles:
+            try:
+                h.result(timeout=600)
+                done += 1
+            except RuntimeError:
+                pass
+        dt = time.perf_counter() - t0
+        router.shutdown()
+        stats = router.stats()
+        arm = {
+            "offered_rps": round(lam, 4),
+            "completed_rps": round(done / dt, 4),
+            "completed_tok_s": round(done * args.max_new / dt, 1),
+            "done": done, "shed": shed, "rejected": rejected,
+            "shed_rate": round((shed + rejected) / n_requests, 3),
+            "recompiles": stats["n_recompiles"],
+            "routed_total": stats["routed_total"],
+            "routed_spill": stats["routed_spill"],
+        }
+        for rep in stats["replicas"]:
+            for key in ("ttft_s", "tpot_s", "e2e_s"):
+                if key in rep:
+                    arm.setdefault(key, rep[key])    # replica-0 view
+        out["arms"][f"load_{load:g}x"] = arm
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

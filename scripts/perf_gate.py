@@ -79,11 +79,13 @@ BASELINE_JSONL_DIR = os.path.join(REPO_ROOT, "results", "perf", "baseline")
 #: The default gate benches: debug-size workloads that finish in seconds
 #: on CPU (bench.py MICRO_BENCHES). One raw train step, one grad-accum
 #: step, one continuous-batching engine run, one fused multi-LoRA step,
-#: one speculative (k=4 verify) engine run — together they fingerprint
-#: the train step builder, the serving engine's whole program family
-#: (plain decode AND spec verify tiers), and the fused-finetune step.
+#: one speculative (k=4 verify) engine run, one fleet-router run —
+#: together they fingerprint the train step builder, the serving
+#: engine's whole program family (plain decode AND spec verify tiers),
+#: the fused-finetune step, and the router path's PER-REPLICA program
+#: family (watch_compiles="first": replica-count invariant).
 GATE_BENCHES = ("micro_train", "micro_accum", "micro_serve",
-                "micro_lora_fusion", "micro_spec")
+                "micro_lora_fusion", "micro_spec", "micro_router")
 
 #: Env fields whose drift invalidates structural comparability (a
 #: different XLA counts different FLOPs) — reported, not silently eaten.

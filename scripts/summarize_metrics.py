@@ -184,6 +184,7 @@ def summarize_serving(metrics, events):
           + (f"; {len(rejected)} REJECTED over capacity" if rejected
              else "") + ")")
     summarize_serving_resilience(failed, shed, expired, events)
+    summarize_serving_fleet(done, metrics, events)
     summarize_adapters(done, failed, events)
     summarize_prefix_kv(metrics, events)
     summarize_spec(done, metrics, events)
@@ -215,6 +216,48 @@ def summarize_serving(metrics, events):
         print(f"  !! {summaries[-1]['n_recompiles']} RECOMPILES after "
               "warmup — prompt lengths outside the warmed bucket set "
               "(see the recompile events' leaf diffs)")
+
+
+def summarize_serving_fleet(done, metrics, events):
+    """Scale-out serving fleet section (serving/router.py): replica
+    count, per-replica request/token split, routing counters (affinity
+    ratio from the replica-attributed ``request_done`` rows), replica
+    drains with their re-dispatched queued work, and restarts."""
+    fleet = [e for e in events if e["event"] == "serve_fleet"]
+    drains = [e for e in events if e["event"] == "replica_drain"]
+    restarts = [e for e in events if e["event"] == "replica_restart"]
+    redis = [e for e in events if e["event"] == "router_redispatch"]
+    with_replica = [e for e in done if e.get("replica") is not None]
+    if not (fleet or drains or redis or restarts or with_replica):
+        return
+    print("  -- scale-out serving fleet --")
+    build = next((e for e in fleet if e.get("phase") == "build"), None)
+    if build:
+        print(f"    {build.get('n_replicas')} replica(s) x "
+              f"tp={build.get('tp')} "
+              f"({'disjoint' if build.get('disjoint_devices') else 'SHARED'}"
+              f" device slices), {build.get('n_adapters', 0)} adapter(s)")
+    per = {}
+    for e in with_replica:
+        c = per.setdefault(e["replica"], {"done": 0, "tokens": 0})
+        c["done"] += 1
+        c["tokens"] += e.get("n_tokens", 0)
+    for rep in sorted(per):
+        c = per[rep]
+        print(f"    replica {rep}: {c['done']} done, "
+              f"{c['tokens']} tokens")
+    if drains:
+        moved = sum(e.get("n_redispatched") or 0 for e in drains
+                    if e.get("phase") == "end")
+        preempted = sum(e.get("n_preempted") or 0 for e in drains
+                        if e.get("phase") == "end")
+        which = sorted({e.get("replica") for e in drains})
+        print(f"    replica drains: {which} — {moved} queued "
+              f"re-dispatched ({len(redis)} redispatch events), "
+              f"{preempted} preempted")
+    if restarts:
+        print(f"    replica restarts: "
+              f"{sorted({e.get('replica') for e in restarts})}")
 
 
 def summarize_adapters(done, failed, events):
