@@ -740,6 +740,29 @@ def bench_serve_fleet(max_new=24, prompt_len=16, n_slots=4,
     for r in replica_counts[1:]:
         if completed.get(1):
             detail[f"speedup_{r}x"] = round(completed[r] / completed[1], 3)
+    # cross-process arm: the SAME sweep at 2 replicas through a
+    # ProcessFleet of supervised worker subprocesses (serving/fleet.py)
+    # reusing the in-process capacity point — the ratio vs the
+    # in-process router bounds RPC-transport + supervision overhead
+    crossproc_ratio = None
+    if 2 in completed:
+        cmd = [sys.executable, worker, "--replicas", "2",
+               "--transport", "process", "--cap_rps", str(cap_rps),
+               "--requests_per_replica", str(rpr),
+               "--max_new", str(mnew), "--prompt_len", str(prompt_len),
+               "--slots", str(n_slots), "--loads", "1.25"]
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=1800, env=env)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"fleet worker (crossproc) failed rc="
+                f"{proc.returncode}:\n{proc.stderr[-2000:]}")
+        row = json.loads(proc.stdout.strip().splitlines()[-1])
+        detail["crossproc_2"] = row["arms"]
+        cp = row["arms"]["load_1.25x"]["completed_rps"]
+        crossproc_ratio = round(cp / completed[2], 3) if completed[2] \
+            else None
+        detail["crossproc_ratio"] = crossproc_ratio
     print(json.dumps(detail), flush=True)
     res = _result("serve_fleet", "fleet aggregate tokens/sec GPT2-124M "
                   f"router {len(replica_counts)}-arm sweep slots{n_slots} "
@@ -750,6 +773,8 @@ def bench_serve_fleet(max_new=24, prompt_len=16, n_slots=4,
         if f"speedup_{r}x" in detail:
             res.add_metric(f"speedup_{r}x", detail[f"speedup_{r}x"],
                            "ratio")
+    if crossproc_ratio is not None:
+        res.add_metric("crossproc_ratio", crossproc_ratio, "ratio")
     return res
 
 
@@ -1461,6 +1486,17 @@ MICRO_BENCHES = ("micro_train", "micro_accum", "micro_serve",
                  "micro_lora_fusion", "micro_spec", "micro_router")
 
 
+def _reset_compilation_cache() -> None:
+    """Drop jax's memoized use-the-persistent-cache decision so a
+    ``jax_compilation_cache_dir`` flip mid-process actually takes effect
+    (the decision is cached per process on first compile)."""
+    try:
+        from jax._src import compilation_cache as _jcc
+        _jcc.reset_cache()
+    except Exception:            # private API: degrade to cache-as-is
+        pass
+
+
 def run_bench(name: str, repeats: int = 1, quick: bool = False
               ) -> perf.BenchResult:
     """Run one bench ``repeats`` times; returns the final repeat's
@@ -1470,6 +1506,17 @@ def run_bench(name: str, repeats: int = 1, quick: bool = False
     global _QUICK
     prev_quick, _QUICK = _QUICK, bool(quick)
     fn = BENCHES[name]
+    # fingerprints must come from COLD XLA compiles: a persistent-
+    # compilation-cache hit deserializes the executable WITHOUT its
+    # alias (donation) sizes, which would corrupt the memory breakdown
+    # the structural gate pins (and make repeat 2's fingerprint drift
+    # from repeat 1's). A cache may be ambiently configured (the
+    # --compile_cache_dir resume path, or JAX_COMPILATION_CACHE_DIR) —
+    # benches opt out for their duration.
+    prev_cache = getattr(jax.config, "jax_compilation_cache_dir", None)
+    if prev_cache:
+        jax.config.update("jax_compilation_cache_dir", None)
+        _reset_compilation_cache()   # drop the memoized use-cache bit
     try:
         values, results, digests = [], [], []
         for _ in range(max(1, int(repeats))):
@@ -1484,6 +1531,9 @@ def run_bench(name: str, repeats: int = 1, quick: bool = False
             results.append(res)
     finally:
         _QUICK = prev_quick
+        if prev_cache:
+            jax.config.update("jax_compilation_cache_dir", prev_cache)
+            _reset_compilation_cache()   # re-arm lazily for later compiles
     final = results[-1]
     final.repeats = perf.repeat_stats(values)
     # a fingerprint that drifts BETWEEN repeats of the same bench is a

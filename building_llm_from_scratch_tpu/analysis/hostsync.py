@@ -75,6 +75,16 @@ HOT_PATHS = {
         # fetch here would sync the tick on every admission
         "PrefixStore.match",
     },
+    "building_llm_from_scratch_tpu/serving/fleet.py": {
+        # router-side per-request paths for the cross-process fleet:
+        # pure host dict/RPC bookkeeping — a device touch here would put
+        # a sync in front of EVERY fleet request, and healthz must stay
+        # answerable from cached heartbeats while a worker is down
+        "ProcessFleet.submit",
+        "ProcessFleet._dispatch_order",
+        "ProcessFleet._apply_event",
+        "ProcessFleet.healthz_payload",
+    },
     "building_llm_from_scratch_tpu/data/prefetch.py": {
         "Prefetcher._fill",
         "Prefetcher.__next__",

@@ -78,6 +78,25 @@ def perform_checks(args) -> None:
             raise ValueError("--serve_slots must be >= 1.")
         if args.serve_replicas < 1:
             raise ValueError("--serve_replicas must be >= 1.")
+        if args.serve_workers < 0:
+            raise ValueError("--serve_workers must be >= 0 "
+                             "(0 = in-process serving).")
+        if args.serve_workers > 0:
+            if args.serve_replicas > 1:
+                raise ValueError(
+                    "--serve_workers and --serve_replicas are two fleet "
+                    "tiers of the same thing: pick in-process replicas "
+                    "(--serve_replicas) OR supervised worker processes "
+                    "(--serve_workers), not both.")
+            if args.load_weights:
+                raise ValueError(
+                    "--serve_workers cannot --load_weights: workers "
+                    "rebuild params from the spec (seed-deterministic "
+                    "init or --init_params_from an exported artifact).")
+            if args.use_lora:
+                raise ValueError(
+                    "--serve_workers with LoRA: pass adapters via "
+                    "--serve_adapters artifacts, not --use_lora.")
         if args.serve_tp < 1:
             raise ValueError("--serve_tp must be >= 1 (devices per "
                              "replica; 1 = unsharded).")
@@ -146,6 +165,7 @@ def perform_checks(args) -> None:
             ("serve_prefix_cache", "off"), ("serve_prefill_chunk", 0),
             ("serve_kv_quant", "model"), ("serve_prefix_budget_mb", 256.0),
             ("serve_spec_k", 0), ("serve_replicas", 1), ("serve_tp", 1),
+            ("serve_workers", 0),
         ) if getattr(args, name) != default]
         if stray:
             raise ValueError(
@@ -404,6 +424,18 @@ def get_args(argv=None):
                              "the device pool allows) and its own "
                              "adapter registry. 1 = the historical "
                              "single-engine path (no router object).")
+    parser.add_argument("--serve_workers", type=int, default=0,
+                        help="Cross-process fleet (serving/fleet.py): run "
+                             "this many supervised worker PROCESSES, each "
+                             "a full replica engine behind the unix-socket "
+                             "RPC transport with its own metrics JSONL. "
+                             "Workers are independently killable: the "
+                             "supervisor detects death (heartbeat + "
+                             "pipe-EOF), re-dispatches the dead worker's "
+                             "queued requests onto survivors and restarts "
+                             "the process with bounded backoff. 0 = "
+                             "in-process serving (the historical paths). "
+                             "Mutually exclusive with --serve_replicas.")
     parser.add_argument("--serve_tp", type=int, default=1,
                         help="Tensor-parallel degree per serving replica: "
                              "the decode/prefill/verify program family "

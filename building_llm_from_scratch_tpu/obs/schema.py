@@ -35,15 +35,16 @@ from typing import Any, Dict, FrozenSet, List
 
 #: Bump when a row type or a load-bearing field changes meaning. The
 #: ``header`` row carries it; consumers key parsing decisions on it.
-SCHEMA_VERSION = 8          # v8: scale-out serving — serve_fleet /
+SCHEMA_VERSION = 9          # v9: cross-process fleet — worker_spawn /
+                            # worker_heartbeat_missed / worker_dead /
+                            # worker_restart / pane_handoff events
+                            # (serving/fleet.py supervision + prefix-
+                            # pane handoff over the RPC transport)
+                            # (v8: scale-out serving — serve_fleet /
                             # replica_drain / replica_restart /
                             # router_redispatch events, `replica` label
                             # on engine-scoped events + span rows,
-                            # `router` request-span child
-                            # (v7: speculative decoding — `draft` tick
-                            # phase, spec_drafted/spec_accepted on
-                            # request_done + cadence rows, serve_warmup
-                            # grew spec_k/drafter)
+                            # `router` request-span child)
 
 #: JSONL row discriminators (the ``type`` field).
 ROW_TYPES = ("header", "metrics", "health", "event", "span")
@@ -74,8 +75,11 @@ REQUEST_EVENTS = ("request_done", "request_rejected", "request_shed",
                   "request_expired", "request_failed")
 
 #: Lifecycle event kinds that open the serving section of the renderer
-#: even when zero requests completed (incident runs).
-SERVING_LIFECYCLE_EVENTS = ("engine_restart", "drain", "serve_error")
+#: even when zero requests completed (incident runs). Worker-process
+#: births/deaths qualify: a fleet run where a worker died before any
+#: request finished is exactly an incident file the section must explain.
+SERVING_LIFECYCLE_EVENTS = ("engine_restart", "drain", "serve_error",
+                            "worker_spawn", "worker_dead", "worker_restart")
 
 #: Root span names the ``span`` row type may carry (one tree per row).
 SPAN_NAMES = ("request",)
@@ -306,6 +310,30 @@ _EVENT_LIST: List[EventSpec] = [
           optional=("from_replica", "to_replica", "adapter"),
           doc="one queued request moved between replicas during a "
               "replica drain — same Request handle, zero client impact"),
+    # -- serving: cross-process fleet (serving/fleet.py) -------------------
+    _spec("worker_spawn", required=("replica", "pid"),
+          optional=("restarts", "seconds"),
+          doc="a supervised worker process came up and passed its ready "
+              "handshake (restarts counts prior incarnations)"),
+    _spec("worker_heartbeat_missed", required=("replica",),
+          optional=("age_s", "timeout_s", "pid"),
+          doc="a live worker went silent past the heartbeat timeout — "
+              "the supervisor kills it (the death path follows)"),
+    _spec("worker_dead", required=("replica", "reason"),
+          optional=("pid", "queued_redispatched", "inflight_failed",
+                    "restarts"),
+          doc="a worker process died (reason: pipe_eof|exit_N|"
+              "heartbeat_missed|events_lost): queued work re-dispatched "
+              "onto survivors, in-flight failed typed"),
+    _spec("worker_restart", required=("replica", "restarts"),
+          optional=("backoff_s", "downtime_s", "pid"),
+          doc="the supervisor restarted a dead worker's PROCESS after "
+              "exponential backoff; it re-enters dispatch"),
+    _spec("pane_handoff", required=("from_replica", "to_replica"),
+          optional=("entries", "imported", "bytes", "seconds"),
+          doc="a draining worker's hot PrefixStore panes shipped over "
+              "the transport to an adopting replica (keys are config-"
+              "fingerprinted, so they transfer verbatim)"),
     _spec("drain", required=("phase",),
           optional=("timeout_s", "n_active", "queue_depth", "n_preempted",
                     "seconds", "requests_finished", "replica"),

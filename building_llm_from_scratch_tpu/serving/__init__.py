@@ -26,6 +26,10 @@ from building_llm_from_scratch_tpu.serving.adapters import (
     AdapterRegistryFullError,
 )
 from building_llm_from_scratch_tpu.serving.engine import DecodeEngine
+from building_llm_from_scratch_tpu.serving.fleet import (
+    ProcessFleet,
+    WorkerSupervisor,
+)
 from building_llm_from_scratch_tpu.serving.kvcache import (
     KVCachePolicy,
     PrefixStore,
@@ -51,6 +55,17 @@ from building_llm_from_scratch_tpu.serving.supervisor import (
     EngineSupervisor,
     FaultHooks,
 )
+from building_llm_from_scratch_tpu.serving.transport import (
+    FrameCorruptError,
+    FrameTooLargeError,
+    PeerGoneError,
+    PeerTimeoutError,
+    TransportError,
+)
+from building_llm_from_scratch_tpu.serving.worker import (
+    EngineSpec,
+    FakeEngine,
+)
 
 __all__ = [
     "AdapterMismatchError",
@@ -60,11 +75,18 @@ __all__ = [
     "Drafter",
     "EngineDrainingError",
     "EngineRouter",
+    "EngineSpec",
     "EngineSupervisor",
+    "FakeEngine",
     "FaultHooks",
+    "FrameCorruptError",
+    "FrameTooLargeError",
     "KVCachePolicy",
     "NgramDrafter",
+    "PeerGoneError",
+    "PeerTimeoutError",
     "PrefixStore",
+    "ProcessFleet",
     "QueueFullError",
     "Request",
     "RequestExpiredError",
@@ -72,4 +94,6 @@ __all__ = [
     "SLOShedError",
     "SamplingParams",
     "Scheduler",
+    "TransportError",
+    "WorkerSupervisor",
 ]

@@ -1,0 +1,13 @@
+"""Process entrypoint for the fleet worker.
+
+Separate from ``serving.worker`` so ``python -m ..serving._worker_main``
+doesn't re-execute a module the ``serving`` package ``__init__`` already
+imported (runpy warns about exactly that).
+"""
+
+import sys
+
+from building_llm_from_scratch_tpu.serving.worker import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,951 @@
+"""Cross-process fleet: supervised worker replicas over the RPC transport.
+
+``EngineRouter`` (serving/router.py) scales replicas inside ONE process
+— one GIL, one blast radius. ``ProcessFleet`` keeps the router's
+dispatch semantics (service-estimate ordering, fall-through admission,
+drain re-dispatch with the SAME ``Request`` handles) but puts every
+replica behind a process boundary:
+
+  - each replica is a ``serving/worker.py`` subprocess with its own
+    metrics JSONL, reached over the unix-socket RPC transport
+    (control) plus a push channel (heartbeats + request progress);
+  - a ``WorkerSupervisor`` per replica watches THREE death signals —
+    missed heartbeats, process exit, and stdout pipe-EOF (kill -9
+    closes the pipe before any timeout can fire) — and restarts the
+    worker process with bounded exponential backoff;
+  - on death, the dead worker's QUEUED requests re-dispatch onto
+    survivors under their original handles (zero lost requests);
+    requests already decoding fail with a typed ``worker_dead`` reason
+    (their tokens died with the process — a silent re-run could emit
+    duplicate text to a streaming client);
+  - restart-budget exhaustion degrades the fleet to the survivors —
+    ``healthz`` says ``degraded``, dispatch keeps flowing;
+  - graceful drain ships the worker's hot ``PrefixStore`` panes over
+    the transport to an adopting replica (keys are config-fingerprint
+    derived, so they transfer verbatim) before the SIGTERM.
+
+The fleet object is engine-shaped: ``make_http_server``/``serve_jsonl``
+/``_serve_frontends`` drive it exactly like a ``DecodeEngine``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from building_llm_from_scratch_tpu.obs.metrics import (
+    get_metrics,
+    render_prometheus,
+)
+from building_llm_from_scratch_tpu.serving.engine import (
+    queue_clear_estimate,
+    service_estimate,
+)
+from building_llm_from_scratch_tpu.serving.queue import (
+    EngineDrainingError,
+    QueueFullError,
+    SLOShedError,
+)
+from building_llm_from_scratch_tpu.serving.request import (
+    FINISHED,
+    Request,
+    SamplingParams,
+    next_request_id,
+)
+from building_llm_from_scratch_tpu.serving.transport import (
+    RpcClient,
+    TransportError,
+    recv_frame,
+    send_frame,
+)
+from building_llm_from_scratch_tpu.serving.worker import EngineSpec
+from building_llm_from_scratch_tpu.utils.logging import setup_logger
+
+logger = setup_logger(__name__)
+
+_WORKER_MODULE = "building_llm_from_scratch_tpu.serving._worker_main"
+
+
+def _labeled(key: str, replica: int) -> str:
+    """Merge ``replica="i"`` into a metric key's label set (same
+    convention as the in-process router's)."""
+    base, sep, labels = key.partition("{")
+    if not sep:
+        return f'{base}{{replica="{replica}"}}'
+    return f'{base}{{{labels[:-1]},replica="{replica}"}}'
+
+
+class _HistSnap:
+    """Duck-typed stand-in for ``obs.metrics.Histogram``: a worker ships
+    its histogram as the SNAPSHOT dict; ``render_prometheus`` only ever
+    calls ``.snapshot()``."""
+
+    __slots__ = ("_snap",)
+
+    def __init__(self, snap: dict):
+        self._snap = snap
+
+    def snapshot(self) -> dict:
+        return self._snap
+
+
+class _FleetEntry:
+    """Ledger row: one in-flight request's cross-process identity."""
+
+    __slots__ = ("req", "prompt_ids", "params", "worker", "state")
+
+    def __init__(self, req: Request, prompt_ids: List[int],
+                 params: Dict[str, Any], worker: int):
+        self.req = req
+        self.prompt_ids = prompt_ids
+        self.params = params
+        self.worker = worker
+        self.state = "queued"        # "queued" | "running"
+
+
+class WorkerSupervisor:
+    """One replica's process + connections + liveness bookkeeping.
+
+    Mutable liveness fields are written under the OWNING fleet's lock
+    (the supervisor is not a standalone object — death/restart
+    transitions need the fleet ledger atomically).
+    """
+
+    __slots__ = ("index", "socket_path", "metrics_path", "proc", "ctrl",
+                 "events_sock", "pid", "alive", "stopped", "restarts",
+                 "last_beat", "snapshot", "generation", "closing",
+                 "out_of_dispatch")
+
+    def __init__(self, index: int, socket_path: str,
+                 metrics_path: Optional[str]):
+        self.index = index
+        self.socket_path = socket_path
+        self.metrics_path = metrics_path
+        self.proc: Optional[subprocess.Popen] = None
+        self.ctrl: Optional[RpcClient] = None
+        self.events_sock: Optional[socket.socket] = None
+        self.pid: Optional[int] = None
+        self.alive = False
+        self.stopped = False         # permanent: drained or budget spent
+        self.restarts = 0
+        self.last_beat = 0.0
+        self.snapshot: Optional[dict] = None
+        self.generation = 0          # bumped per spawn; stale-event guard
+        self.closing = False         # intentional teardown in progress
+        self.out_of_dispatch = False
+
+
+class ProcessFleet:
+    """N supervised worker processes behind one engine-shaped facade."""
+
+    def __init__(self, spec: EngineSpec, n_workers: int, *,
+                 tokenizer=None, socket_dir: Optional[str] = None,
+                 metrics_base: Optional[str] = None,
+                 heartbeat_s: float = 0.25,
+                 heartbeat_timeout_s: Optional[float] = None,
+                 max_restarts: int = 3, restart_backoff_s: float = 0.5,
+                 call_timeout_s: float = 10.0,
+                 ready_timeout_s: float = 180.0,
+                 drain_timeout_s: float = 30.0,
+                 default_max_new_tokens: Optional[int] = None):
+        if n_workers < 1:
+            raise ValueError("n_workers must be >= 1")
+        self.spec = spec
+        self.n_workers = n_workers
+        self.tokenizer = tokenizer
+        self.heartbeat_s = heartbeat_s
+        self.heartbeat_timeout_s = (heartbeat_timeout_s
+                                    if heartbeat_timeout_s is not None
+                                    else 20.0 * heartbeat_s)
+        self.max_restarts = int(max_restarts)
+        self.restart_backoff_s = float(restart_backoff_s)
+        self.call_timeout_s = float(call_timeout_s)
+        self.ready_timeout_s = float(ready_timeout_s)
+        self.drain_timeout_s = float(drain_timeout_s)
+        if default_max_new_tokens is None:
+            default_max_new_tokens = int(
+                (spec.fake or {}).get("default_max_new_tokens")
+                or spec.engine.get("default_max_new_tokens", 128))
+        self.default_max_new_tokens = default_max_new_tokens
+        self.warmed_up = False
+        self._dir = socket_dir or tempfile.mkdtemp(prefix="fleet_")
+        self._lock = threading.Lock()
+        self._requests: Dict[int, _FleetEntry] = {}    # guarded-by: _lock
+        self._draining = False
+        self._closing = False
+        self.n_deaths = 0                              # guarded-by: _lock
+        self.n_restarts = 0                            # guarded-by: _lock
+        self.n_redispatched = 0                        # guarded-by: _lock
+        self.n_failed_on_death = 0                     # guarded-by: _lock
+        self.workers = [
+            WorkerSupervisor(
+                i, os.path.join(self._dir, f"w{i}.sock"),
+                # each worker owns its metrics JSONL next to the
+                # supervisor's: <base>.worker<i>.jsonl
+                (f"{metrics_base}.worker{i}.jsonl"
+                 if metrics_base else None))
+            for i in range(n_workers)]
+        self._monitor: Optional[threading.Thread] = None
+        self._t0 = time.monotonic()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "ProcessFleet":
+        t0 = time.monotonic()
+        get_metrics().event("serve_fleet", phase="build",
+                            n_replicas=self.n_workers, tp=self.spec.tp)
+        errs: List[BaseException] = []
+
+        def boot(w: WorkerSupervisor) -> None:
+            try:
+                self._spawn(w)
+            except BaseException as e:       # noqa: BLE001 - collected
+                errs.append(e)
+
+        threads = [threading.Thread(target=boot, args=(w,), daemon=True)
+                   for w in self.workers]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errs:
+            self.shutdown(drain=False)
+            raise RuntimeError(f"fleet start failed: {errs[0]}") from errs[0]
+        self.warmed_up = True
+        self._monitor = threading.Thread(target=self._monitor_loop,
+                                         name="fleet-monitor", daemon=True)
+        self._monitor.start()
+        get_metrics().event("serve_fleet", phase="end",
+                            n_replicas=self.n_workers, tp=self.spec.tp,
+                            seconds=round(time.monotonic() - t0, 3))
+        return self
+
+    def warmup(self) -> None:
+        """Workers warm their own engines before the ready line; kept
+        for engine-surface parity."""
+
+    def _spawn(self, w: WorkerSupervisor) -> None:
+        """Start (or restart) one worker process and wire it up. Raises
+        on failure — callers own the retry/backoff policy."""
+        t0 = time.monotonic()
+        if os.path.exists(w.socket_path):
+            os.unlink(w.socket_path)
+        cmd = [sys.executable, "-m", _WORKER_MODULE,
+               "--socket", w.socket_path,
+               "--spec", self.spec.to_json(),
+               "--replica", str(w.index),
+               "--heartbeat_s", str(self.heartbeat_s),
+               "--drain_timeout", str(self.drain_timeout_s)]
+        if w.metrics_path:
+            cmd += ["--metrics_jsonl", w.metrics_path]
+        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True)
+        ready = None
+        deadline = time.monotonic() + self.ready_timeout_s
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            if not line:
+                raise RuntimeError(
+                    f"worker {w.index} exited before ready "
+                    f"(rc={proc.poll()})")
+            try:
+                import json as _json
+
+                obj = _json.loads(line)
+            except ValueError:
+                continue                     # stray log line on stdout
+            if isinstance(obj, dict) and obj.get("ready"):
+                ready = obj
+                break
+        if ready is None:
+            proc.kill()
+            raise RuntimeError(
+                f"worker {w.index} not ready within "
+                f"{self.ready_timeout_s}s")
+        ctrl = RpcClient(w.socket_path, timeout=self.call_timeout_s)
+        ev_sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        ev_sock.connect(w.socket_path)
+        send_frame(ev_sock, {"method": "subscribe", "args": {}})
+        recv_frame(ev_sock)                  # ack
+        ev_sock.settimeout(None)
+        with self._lock:
+            w.generation += 1
+            gen = w.generation
+            w.proc = proc
+            w.ctrl = ctrl
+            w.events_sock = ev_sock
+            w.pid = int(ready["pid"])
+            w.alive = True
+            w.closing = False
+            w.out_of_dispatch = False
+            w.last_beat = time.monotonic()
+        threading.Thread(target=self._stdout_loop, args=(w, gen, proc),
+                         name=f"fleet-stdout-{w.index}",
+                         daemon=True).start()
+        threading.Thread(target=self._event_loop, args=(w, gen, ev_sock),
+                         name=f"fleet-events-{w.index}",
+                         daemon=True).start()
+        get_metrics().event("worker_spawn", replica=w.index, pid=w.pid,
+                            restarts=w.restarts,
+                            seconds=round(time.monotonic() - t0, 3))
+        logger.info("Worker %d up (pid %d, %.2fs).", w.index, w.pid,
+                    time.monotonic() - t0)
+
+    # -- liveness ----------------------------------------------------------
+
+    def _stdout_loop(self, w: WorkerSupervisor, gen: int,
+                     proc: subprocess.Popen) -> None:
+        """Drain the worker's stdout; EOF is the fastest kill -9 signal
+        (the kernel closes the pipe the instant the process dies)."""
+        for _ in proc.stdout:
+            pass
+        self._on_death(w, gen, "pipe_eof")
+
+    def _event_loop(self, w: WorkerSupervisor, gen: int,
+                    sock: socket.socket) -> None:
+        while True:
+            try:
+                ev = recv_frame(sock)
+            except TransportError:
+                self._on_death(w, gen, "events_lost")
+                return
+            try:
+                self._apply_event(w, gen, ev)
+            except Exception:                # noqa: BLE001
+                logger.exception("Worker %d: bad event %r.", w.index, ev)
+
+    def _monitor_loop(self) -> None:
+        while not self._closing:
+            time.sleep(self.heartbeat_s)
+            now = time.monotonic()
+            for w in self.workers:
+                with self._lock:
+                    live = w.alive and not w.closing
+                    gen = w.generation
+                    age = now - w.last_beat
+                if not live:
+                    continue
+                if w.proc is not None and w.proc.poll() is not None:
+                    self._on_death(w, gen, f"exit_{w.proc.returncode}")
+                    continue
+                if age > self.heartbeat_timeout_s:
+                    get_metrics().event(
+                        "worker_heartbeat_missed", replica=w.index,
+                        age_s=round(age, 3),
+                        timeout_s=self.heartbeat_timeout_s, pid=w.pid)
+                    logger.error(
+                        "Worker %d: no heartbeat for %.2fs (timeout "
+                        "%.2fs) — killing it.", w.index, age,
+                        self.heartbeat_timeout_s)
+                    try:
+                        w.proc.kill()
+                    except OSError:
+                        pass
+                    self._on_death(w, gen, "heartbeat_missed")
+
+    # -- events ------------------------------------------------------------
+
+    def _apply_event(self, w: WorkerSupervisor, gen: int,
+                     ev: dict) -> None:
+        kind = ev.get("ev")
+        if kind == "heartbeat":
+            with self._lock:
+                if w.generation == gen:
+                    w.last_beat = time.monotonic()
+                    w.snapshot = ev.get("snapshot")
+            return
+        cid = ev.get("client_id")
+        with self._lock:
+            entry = self._requests.get(cid)
+            if entry is not None and entry.worker != w.index:
+                entry = None                 # stale frame from a pre-
+                # redispatch owner: the handle moved on
+        if entry is None:
+            return
+        req = entry.req
+        if kind == "admitted":
+            with self._lock:
+                entry.state = "running"
+            if req.t_admit is None:
+                req.t_admit = time.monotonic()
+            return
+        if kind == "piece":
+            if req.done:
+                return
+            if req.t_first_token is None:
+                req.t_first_token = time.monotonic()
+            req.output_ids.append(  # graft-ok: GL011 wire JSON int, host-resident
+                int(ev["token"]))
+            req.text += ev["piece"]
+            if req.on_token is not None:
+                req.on_token(req,  # graft-ok: GL011 wire JSON int, host-resident
+                             int(ev["token"]), ev["piece"])
+            req._push_piece(ev["piece"])
+            return
+        if kind == "done":
+            with self._lock:
+                self._requests.pop(cid, None)
+            if req.done:
+                return
+            req.output_ids = [int(t) for t in  # graft-ok: GL011 wire JSON ints, host-resident
+                              ev["token_ids"]]
+            req.text = ev["text"]
+            req.finish_reason = ev.get("finish_reason")
+            req.state = FINISHED
+            if req.t_first_token is None and req.output_ids:
+                req.t_first_token = time.monotonic()
+            req.t_finish = time.monotonic()
+            req._mark_done()
+            return
+        if kind == "failed":
+            with self._lock:
+                self._requests.pop(cid, None)
+            if req.done:
+                return
+            req.finish_reason = ev.get("reason")
+            req.error = ev.get("error") or ev.get("reason")
+            req.state = FINISHED
+            req.t_finish = time.monotonic()
+            req._mark_done()
+            return
+
+    # -- death + restart ---------------------------------------------------
+
+    def _on_death(self, w: WorkerSupervisor, gen: int,
+                  reason: str) -> None:
+        """The crash path: runs AT MOST ONCE per worker incarnation
+        (generation-gated), from whichever liveness signal fires first."""
+        with self._lock:
+            if w.generation != gen or not w.alive or w.closing:
+                return
+            w.alive = False
+            w.snapshot = None
+            self.n_deaths += 1
+            mine = [e for e in self._requests.values()
+                    if e.worker == w.index]
+            queued = [e for e in mine
+                      if e.state == "queued" and not e.req.output_ids]
+            running = [e for e in mine if e not in queued]
+            for e in mine:
+                self._requests.pop(e.req.id, None)
+        pid = w.pid
+        if w.ctrl is not None:
+            w.ctrl.close()
+        if w.events_sock is not None:
+            try:
+                w.events_sock.close()
+            except OSError:
+                pass
+        get_metrics().event("worker_dead", replica=w.index, reason=reason,
+                            pid=pid, queued_redispatched=len(queued),
+                            inflight_failed=len(running),
+                            restarts=w.restarts)
+        logger.error(
+            "Worker %d DIED (%s, pid %s): re-dispatching %d queued, "
+            "failing %d in-flight.", w.index, reason, pid, len(queued),
+            len(running))
+        for e in running:
+            self._fail_entry(e, "worker_dead",
+                             f"worker_dead: worker {w.index} died "
+                             f"mid-decode ({reason})")
+        for e in queued:
+            self._redispatch(e, from_replica=w.index)
+        if self._closing or self._draining:
+            return
+        if w.restarts >= self.max_restarts:
+            with self._lock:
+                w.stopped = True
+            logger.error(
+                "Worker %d: restart budget (%d) exhausted — fleet "
+                "degrades to survivors.", w.index, self.max_restarts)
+            return
+        threading.Thread(target=self._restart, args=(w,),
+                         name=f"fleet-restart-{w.index}",
+                         daemon=True).start()
+
+    def _restart(self, w: WorkerSupervisor) -> None:
+        t_dead = time.monotonic()
+        while not (self._closing or self._draining):
+            if w.restarts >= self.max_restarts:
+                with self._lock:
+                    w.stopped = True
+                logger.error(
+                    "Worker %d: restart budget (%d) exhausted — fleet "
+                    "degrades to survivors.", w.index, self.max_restarts)
+                return
+            backoff = self.restart_backoff_s * (2.0 ** w.restarts)
+            w.restarts += 1
+            time.sleep(backoff)
+            if self._closing or self._draining:
+                return
+            try:
+                self._spawn(w)
+            except Exception as e:           # noqa: BLE001 - retry loop
+                logger.error("Worker %d: restart attempt %d failed: %s",
+                             w.index, w.restarts, e)
+                continue
+            with self._lock:
+                self.n_restarts += 1
+            get_metrics().event(
+                "worker_restart", replica=w.index, restarts=w.restarts,
+                backoff_s=round(backoff, 3),
+                downtime_s=round(time.monotonic() - t_dead, 3), pid=w.pid)
+            logger.warning("Worker %d restarted (attempt %d, %.2fs down) "
+                           "— back in dispatch.", w.index, w.restarts,
+                           time.monotonic() - t_dead)
+            return
+
+    def _fail_entry(self, e: _FleetEntry, reason: str, msg: str) -> None:
+        req = e.req
+        if req.done:
+            return
+        with self._lock:
+            self.n_failed_on_death += 1
+        req.finish_reason = "error"
+        req.error = msg
+        req.state = FINISHED
+        req.t_finish = time.monotonic()
+        req._mark_done()
+
+    def _redispatch(self, e: _FleetEntry, from_replica: int) -> None:
+        """Move one queued request to a survivor under its ORIGINAL
+        handle (``drain_replica`` semantics across the process
+        boundary)."""
+        req = e.req
+        for w in self._dispatch_order(max_new=e.params.get(
+                "max_new_tokens", self.default_max_new_tokens)):
+            if w.index == from_replica:
+                continue
+            e.worker = w.index
+            e.state = "queued"
+            with self._lock:
+                self._requests[req.id] = e
+            try:
+                w.ctrl.call("adopt", client_id=req.id,
+                            prompt_ids=e.prompt_ids, params=e.params,
+                            route={"replica": w.index,
+                                   "redispatched_from": from_replica})
+            except (QueueFullError, SLOShedError, EngineDrainingError,
+                    TransportError, RuntimeError) as err:
+                with self._lock:
+                    if self._requests.get(req.id) is e:
+                        del self._requests[req.id]
+                logger.warning("Redispatch of %d to worker %d refused: "
+                               "%s", req.id, w.index, err)
+                continue
+            with self._lock:
+                self.n_redispatched += 1
+            if req.route:
+                req.route = {**req.route, "replica": w.index,
+                             "redispatched_from": from_replica}
+            get_metrics().event("router_redispatch", request_id=req.id,
+                                from_replica=from_replica,
+                                to_replica=w.index)
+            return
+        self._fail_entry(e, "worker_dead",
+                         f"worker_dead: worker {from_replica} died and "
+                         "no survivor accepted the request")
+
+    # -- dispatch ----------------------------------------------------------
+
+    def _live(self) -> List[WorkerSupervisor]:
+        with self._lock:
+            return [w for w in self.workers
+                    if w.alive and not (w.closing or w.out_of_dispatch)]
+
+    def _dispatch_order(self, max_new: int) -> List[WorkerSupervisor]:
+        """Live workers, cheapest predicted service first (same pure
+        ``service_estimate`` the in-process router sorts by, computed
+        from heartbeat snapshots)."""
+        scored = []
+        for w in self._live():
+            snap = w.snapshot or {}
+            est = service_estimate(
+                snap.get("queue_depth", 0), snap.get("n_active", 0),
+                snap.get("n_slots", 1), snap.get("tpot_ewma"),
+                snap.get("tokens_ewma"), max_new)
+            scored.append((est if est is not None else 0.0, w.index, w))
+        scored.sort(key=lambda t: (t[0], t[1]))
+        return [w for _, _, w in scored]
+
+    def submit(self, prompt, params: Optional[SamplingParams] = None,
+               block: bool = False, timeout: Optional[float] = None,
+               on_token=None, route=None) -> Request:
+        if self._draining:
+            raise EngineDrainingError(
+                "fleet is draining: admission closed",
+                retry_after_s=self.drain_timeout_s)
+        params = params or SamplingParams(
+            max_new_tokens=self.default_max_new_tokens)
+        if isinstance(prompt, str):
+            if self.tokenizer is None:
+                raise ValueError("text prompt needs a tokenizer")
+            prompt_ids = np.asarray(  # graft-ok: GL012 tokenizer host list, no device
+                self.tokenizer.encode(prompt), np.int32)
+        else:
+            prompt_ids = np.asarray(  # graft-ok: GL012 caller host ids, no device
+                prompt, np.int32).reshape(-1)
+        wire_params = {k: v for k, v in
+                       dataclasses.asdict(params).items()
+                       if v is not None}
+        wire_ids = [int(t) for t in prompt_ids]  # graft-ok: GL011 host numpy, no device
+        req = Request(next_request_id(), prompt_ids, params, on_token)
+        deadline = (time.monotonic() + timeout
+                    if (block and timeout is not None) else None)
+        while True:
+            first_refusal: Optional[BaseException] = None
+            order = self._dispatch_order(params.max_new_tokens)
+            for w in order:
+                entry = _FleetEntry(req, wire_ids, wire_params, w.index)
+                with self._lock:
+                    self._requests[req.id] = entry
+                try:
+                    w.ctrl.call("submit", client_id=req.id,
+                                prompt_ids=wire_ids, params=wire_params,
+                                route={"replica": w.index})
+                except (QueueFullError, SLOShedError) as e:
+                    claimed = self._unclaim(req, entry)
+                    if not claimed:
+                        return req           # death path owns it now
+                    if first_refusal is None:
+                        first_refusal = e
+                    continue
+                except (EngineDrainingError, TransportError,
+                        RuntimeError):
+                    if not self._unclaim(req, entry):
+                        return req
+                    continue
+                req.route = route or {"replica": w.index}
+                return req
+            if not order:
+                first_refusal = first_refusal or RuntimeError(
+                    "no live workers")
+            if not block:
+                raise first_refusal or QueueFullError(
+                    "every live worker refused admission")
+            if deadline is not None and time.monotonic() >= deadline:
+                raise first_refusal or QueueFullError(
+                    f"no worker admitted the request within {timeout}s")
+            time.sleep(0.05)
+
+    def _unclaim(self, req: Request, entry: _FleetEntry) -> bool:
+        """Remove a not-yet-acked ledger entry; False when the death
+        path already claimed it (it owns the request's fate then)."""
+        with self._lock:
+            if self._requests.get(req.id) is entry:
+                del self._requests[req.id]
+                return True
+        return False
+
+    def cancel(self, req: Request) -> bool:
+        with self._lock:
+            entry = self._requests.get(req.id)
+        if entry is None:
+            return False
+        w = self.workers[entry.worker]
+        try:
+            out = w.ctrl.call("cancel", client_id=req.id)
+        except (TransportError, RuntimeError):
+            return False
+        return bool(out.get("cancelled"))
+
+    # -- drain / handoff ---------------------------------------------------
+
+    def drain_worker(self, i: int, timeout: Optional[float] = None,
+                     handoff_to: Optional[int] = None) -> dict:
+        """Gracefully retire worker ``i``: steal its queue (re-dispatch
+        under the same handles), hand its hot prefix panes to a
+        survivor, let in-flight work finish, then SIGTERM the process."""
+        timeout = self.drain_timeout_s if timeout is None else timeout
+        w = self.workers[i]
+        with self._lock:
+            if not w.alive:
+                return {"drained": False, "reason": "not alive"}
+            w.out_of_dispatch = True
+        t0 = time.monotonic()
+        get_metrics().event("replica_drain", replica=i, phase="start",
+                            timeout_s=timeout)
+        stolen: List[int] = []
+        try:
+            stolen = w.ctrl.call("steal_queue").get("client_ids", [])
+        except (TransportError, RuntimeError) as e:
+            logger.warning("Drain of worker %d: steal_queue failed "
+                           "(%s).", i, e)
+        for cid in stolen:
+            with self._lock:
+                e = self._requests.get(cid)
+            if e is not None:
+                self._redispatch(e, from_replica=i)
+        self._handoff_panes(w, handoff_to)
+        try:
+            w.ctrl.call("drain", rpc_timeout=timeout + 10.0,
+                        timeout=timeout)
+        except (TransportError, RuntimeError) as e:
+            logger.warning("Drain RPC to worker %d failed: %s", i, e)
+        self._stop_worker(w)
+        get_metrics().event("replica_drain", replica=i, phase="end",
+                            n_redispatched=len(stolen),
+                            seconds=round(time.monotonic() - t0, 3))
+        return {"drained": True, "redispatched": len(stolen),
+                "seconds": round(time.monotonic() - t0, 3)}
+
+    def _handoff_panes(self, w: WorkerSupervisor,
+                       handoff_to: Optional[int]) -> None:
+        """Ship the draining worker's PrefixStore over the transport to
+        an adopting replica. Keys are config-fingerprinted — identical
+        across same-spec workers — so the adoptee serves the donor's
+        prefixes as hits, no recompute."""
+        targets = [t for t in self._live() if t.index != w.index]
+        if handoff_to is not None:
+            targets = [t for t in targets if t.index == handoff_to]
+        if not targets:
+            return
+        t0 = time.monotonic()
+        try:
+            exported = w.ctrl.call(
+                "export_panes",
+                rpc_timeout=max(self.call_timeout_s, 30.0))
+        except (TransportError, RuntimeError) as e:
+            logger.warning("Pane export from worker %d failed: %s",
+                           w.index, e)
+            return
+        entries = exported.get("entries", [])
+        if not entries:
+            return
+        adoptee = targets[0]
+        try:
+            res = adoptee.ctrl.call(
+                "import_panes", entries=entries,
+                rpc_timeout=max(self.call_timeout_s, 30.0))
+        except (TransportError, RuntimeError) as e:
+            logger.warning("Pane import into worker %d failed: %s",
+                           adoptee.index, e)
+            return
+        get_metrics().event(
+            "pane_handoff", from_replica=w.index, to_replica=adoptee.index,
+            entries=len(entries), imported=res.get("imported", 0),
+            bytes=res.get("bytes", 0),
+            seconds=round(time.monotonic() - t0, 3))
+        logger.info("Prefix panes handed off %d -> %d: %d entries, %d "
+                    "bytes, %.3fs.", w.index, adoptee.index,
+                    len(entries), res.get("bytes", 0),
+                    time.monotonic() - t0)
+
+    def _stop_worker(self, w: WorkerSupervisor) -> None:
+        """Intentional teardown of one worker process (no death path)."""
+        with self._lock:
+            w.closing = True
+            w.alive = False
+            w.stopped = True
+            w.snapshot = None
+        if w.proc is not None and w.proc.poll() is None:
+            try:
+                w.proc.terminate()
+                w.proc.wait(timeout=10.0)
+            except subprocess.TimeoutExpired:
+                w.proc.kill()
+                w.proc.wait(timeout=5.0)
+            except OSError:
+                pass
+        if w.ctrl is not None:
+            w.ctrl.close()
+        if w.events_sock is not None:
+            try:
+                w.events_sock.close()
+            except OSError:
+                pass
+
+    def drain(self, timeout: float = 30.0) -> dict:
+        """Rolling fleet drain: retire workers one at a time (queue
+        steal + pane handoff to survivors), plain-drain the last."""
+        self._draining = True
+        t0 = time.monotonic()
+        live = [w.index for w in self.workers
+                if w.alive and not w.closing]
+        n_re = 0
+        for i in live[:-1]:
+            out = self.drain_worker(i, timeout=timeout)
+            n_re += out.get("redispatched", 0)
+        for i in live[-1:]:
+            w = self.workers[i]
+            try:
+                w.ctrl.call("drain", rpc_timeout=timeout + 10.0,
+                            timeout=timeout)
+            except (TransportError, RuntimeError) as e:
+                logger.warning("Final drain RPC to worker %d failed: %s",
+                               i, e)
+            self._stop_worker(w)
+        summary = {"seconds": round(time.monotonic() - t0, 3),
+                   "redispatched": n_re}
+        get_metrics().event("drain", phase="end", seconds=summary["seconds"])
+        return summary
+
+    def shutdown(self, drain: bool = True) -> None:
+        if drain and not self._draining:
+            self.drain(timeout=self.drain_timeout_s)
+        self._closing = True
+        self._draining = True
+        for w in self.workers:
+            self._stop_worker(w)
+        # fail anything still in the ledger so no client hangs forever
+        with self._lock:
+            leftovers = list(self._requests.values())
+            self._requests.clear()
+        for e in leftovers:
+            if not e.req.done:
+                e.req.finish_reason = "preempted"
+                e.req.error = "fleet shutdown"
+                e.req._mark_done()
+
+    def run_until_idle(self) -> None:
+        while True:
+            with self._lock:
+                if not self._requests:
+                    return
+            time.sleep(0.01)
+
+    # -- engine-shaped introspection --------------------------------------
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def n_recompiles(self) -> int:
+        """Sum of live workers' recompile counters (fault tests assert
+        survivors stay at zero through a neighbor's death)."""
+        total = 0
+        for s in self._worker_stats().values():
+            total += int(s.get("n_recompiles", 0))
+        return total
+
+    def queue_capacity(self) -> int:
+        cap = 0
+        for w in self.workers:
+            snap = w.snapshot or {}
+            cap += int(snap.get("queue_capacity", 0))
+        if cap:
+            return cap
+        per = ((self.spec.fake or {}).get("max_queue")
+               or self.spec.engine.get("max_queue", 64))
+        return int(per) * self.n_workers
+
+    def estimate_queue_clear_s(self) -> Optional[float]:
+        best = None
+        for w in self._live():
+            snap = w.snapshot or {}
+            est = queue_clear_estimate(
+                snap.get("queue_depth", 0), snap.get("n_active", 0),
+                snap.get("n_slots", 1), snap.get("tpot_ewma"),
+                snap.get("tokens_ewma"))
+            if est is not None and (best is None or est < best):
+                best = est
+        return best
+
+    def _worker_stats(self) -> Dict[int, dict]:
+        out: Dict[int, dict] = {}
+        for w in self._live():
+            try:
+                out[w.index] = w.ctrl.call("stats")
+            except (TransportError, RuntimeError):
+                continue
+        return out
+
+    def stats(self) -> dict:
+        per = self._worker_stats()
+        with self._lock:
+            out = {
+                "n_workers": self.n_workers,
+                "workers_up": sum(1 for w in self.workers if w.alive),
+                "worker_deaths": self.n_deaths,
+                "worker_restarts": self.n_restarts,
+                "redispatched_total": self.n_redispatched,
+                "failed_on_death": self.n_failed_on_death,
+                "in_flight": len(self._requests),
+                "draining": self._draining,
+            }
+        out["n_recompiles"] = sum(int(s.get("n_recompiles", 0))
+                                  for s in per.values())
+        out["requests_finished"] = sum(int(s.get("requests_finished", 0))
+                                       for s in per.values())
+        out["workers"] = {i: per[i] for i in sorted(per)}
+        return out
+
+    def metrics_snapshot(self) -> tuple:
+        counters: Dict[str, float] = {}
+        gauges: Dict[str, float] = {}
+        hists: Dict[str, Any] = {}
+        for w in self._live():
+            try:
+                m = w.ctrl.call("metrics")
+            except (TransportError, RuntimeError):
+                continue
+            for k, v in m.get("counters", {}).items():
+                counters[_labeled(k, w.index)] = v
+            for k, v in m.get("gauges", {}).items():
+                gauges[_labeled(k, w.index)] = v
+            for k, v in m.get("hists", {}).items():
+                hists[_labeled(k, w.index)] = _HistSnap(v)
+        with self._lock:
+            up = sum(1 for w in self.workers if w.alive)
+            gauges["fleet_workers_up"] = float(up)
+            gauges["fleet_workers_total"] = float(self.n_workers)
+            counters["fleet_worker_deaths_total"] = float(self.n_deaths)
+            counters["fleet_worker_restarts_total"] = float(
+                self.n_restarts)
+            counters["fleet_redispatched_total"] = float(
+                self.n_redispatched)
+        return counters, gauges, hists
+
+    def prometheus_text(self) -> str:
+        counters, gauges, hists = self.metrics_snapshot()
+        return render_prometheus(counters, gauges, hists)
+
+    def healthz_payload(self) -> dict:
+        """Fleet health WITHOUT any RPC: built from cached heartbeat
+        snapshots, so a downed/restarting worker can never stall or
+        fail the health endpoint — it reports ``degraded`` instead."""
+        now = time.monotonic()
+        replicas = []
+        up = 0
+        with self._lock:
+            draining = self._draining
+            for w in self.workers:
+                if w.alive:
+                    status = "serving"
+                    up += 1
+                elif w.stopped:
+                    status = "drained" if w.closing else "dead"
+                else:
+                    status = "restarting"
+                row = {"replica": w.index, "status": status,
+                       "restarts": w.restarts, "pid": w.pid}
+                snap = w.snapshot
+                if w.alive and snap:
+                    row["queue_depth"] = snap.get("queue_depth")
+                    row["active"] = snap.get("n_active")
+                    row["heartbeat_age_s"] = round(now - w.last_beat, 3)
+                replicas.append(row)
+        if draining:
+            status = "draining"
+        elif up == 0:
+            status = "dead"
+        elif up < self.n_workers:
+            status = "degraded"
+        else:
+            status = "serving"
+        return {"status": status, "workers_up": up,
+                "workers_total": self.n_workers,
+                "uptime_s": round(now - self._t0, 3),
+                "draining": draining, "replicas": replicas}
+
+
+__all__ = ["ProcessFleet", "WorkerSupervisor"]

@@ -355,6 +355,56 @@ def run_serve(args, comps, metric_logger) -> DecodeEngine:
     )
     n_replicas = getattr(args, "serve_replicas", 1)
     serve_tp = getattr(args, "serve_tp", 1)
+    n_workers = getattr(args, "serve_workers", 0)
+    if n_workers > 0:
+        # cross-process fleet (serving/fleet.py): N supervised worker
+        # PROCESSES behind one engine-shaped facade. Workers rebuild
+        # cfg + params from the spec (init_params is seed-deterministic;
+        # --init_params_from loads the same artifact in every process),
+        # so the parent's params never cross the process boundary — and
+        # a worker crash can only ever take down its own replica.
+        from building_llm_from_scratch_tpu.serving.fleet import (
+            ProcessFleet,
+        )
+        from building_llm_from_scratch_tpu.serving.worker import (
+            EngineSpec,
+        )
+
+        adapter_paths = (parse_adapter_specs(args.serve_adapters)
+                         if getattr(args, "serve_adapters", None)
+                         else None)
+        spec = EngineSpec(
+            model=args.model, size=args.num_params,
+            dtype=args.data_type, debug=args.debug, seed=args.seed,
+            init_params_from=getattr(args, "init_params_from", None),
+            tokenizer=("byte" if args.byte_tokenizer else "none"),
+            tp=serve_tp,
+            engine=dict(
+                n_slots=args.serve_slots,
+                max_len=(args.serve_max_len or None),
+                max_queue=args.serve_max_queue,
+                max_top_k=args.serve_max_top_k,
+                default_max_new_tokens=args.serve_max_new_tokens,
+                default_deadline_s=(args.serve_deadline_s or None),
+                tick_timeout_s=args.serve_tick_timeout,
+                max_restarts=args.serve_max_restarts,
+                metrics_every=args.serve_metrics_every),
+            kv_policy=dict(
+                kv_quant=kv_policy.kv_quant,
+                prefix_cache=kv_policy.prefix_cache,
+                prefill_chunk=kv_policy.prefill_chunk,
+                prefix_budget_bytes=kv_policy.prefix_budget_bytes),
+            adapters=adapter_paths,
+            spec_k=getattr(args, "serve_spec_k", 0),
+        )
+        fleet = ProcessFleet(
+            spec, n_workers, tokenizer=comps.tokenizer,
+            max_restarts=args.serve_max_restarts,
+            drain_timeout_s=args.drain_timeout,
+            default_max_new_tokens=args.serve_max_new_tokens,
+            metrics_base=metric_logger.jsonl_path)
+        fleet.start()
+        return _serve_frontends(args, fleet, [], metric_logger)
     if n_replicas > 1:
         # fleet tier (serving/router.py): N engine replicas — each on
         # its own mesh plan (tp devices apiece, disjoint when the pool

@@ -368,8 +368,30 @@ class PrefixStore:
         entry's byte size, or 0 — skipped (and counted) — when the
         entry alone exceeds the budget or everything evictable is
         pinned (also 0, uncounted, when the key is already stored)."""
+        return self._insert_keyed(self.key(token_ids, tag), panes,
+                                  len(token_ids))
+
+    def import_entry(self, key: str, panes: Params, span: int) -> int:
+        """Raw-key insert for cross-process pane handoff (fleet drain).
+
+        The key is sha1(fingerprint, tag, tokens) computed by the donor;
+        fingerprints are config-derived, so same-config workers agree on
+        every key and the donor's keys import verbatim — the adoptee
+        serves the shared prefix as a hit without recomputing anything.
+        Same LRU/budget/pin discipline as ``insert``."""
+        return self._insert_keyed(key, panes, int(span))
+
+    def export_entries(self) -> list:
+        """Snapshot ``[(key, span, panes)]`` LRU-first (so the adoptee's
+        LRU order, rebuilt by importing in sequence, matches the
+        donor's). Panes are the live device/host arrays — the transport
+        layer serializes them."""
+        with self._lock:
+            return [(e.key, e.span, e.panes)
+                    for e in self._entries.values()]
+
+    def _insert_keyed(self, k: str, panes: Params, span: int) -> int:
         nbytes = cache_nbytes(panes)
-        k = self.key(token_ids, tag)
         evicted = []
         with self._lock:
             if k in self._entries:
@@ -389,7 +411,7 @@ class PrefixStore:
                 self.bytes_total -= victim.nbytes
                 self.n_evictions += 1
                 evicted.append(victim)
-            entry = _Entry(k, panes, len(token_ids), nbytes)
+            entry = _Entry(k, panes, span, nbytes)
             self._entries[k] = entry
             self.bytes_total += nbytes
             self.n_inserts += 1
@@ -402,7 +424,7 @@ class PrefixStore:
                 age_s=round(time.monotonic() - victim.t_insert, 3),
                 entries_left=n_entries, bytes_left=bytes_total)
         logger.debug("Prefix stored: %s span %d (%d bytes, %d entries, "
-                     "%d evicted).", k[:12], len(token_ids), nbytes,
+                     "%d evicted).", k[:12], span, nbytes,
                      n_entries, len(evicted))
         return nbytes
 

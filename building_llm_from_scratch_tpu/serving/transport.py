@@ -1,0 +1,372 @@
+"""Stdlib-only RPC transport for the cross-process fleet.
+
+Framing is deliberately boring: a 4-byte big-endian length prefix
+followed by a UTF-8 JSON payload over an AF_UNIX stream socket. Boring
+is the point — the supervisor must classify every way a worker can
+misbehave into a TYPED error it can act on:
+
+  - ``PeerGoneError``    — EOF / reset / refused connection: the process
+    on the other end is dead (or never existed). The supervisor's cue to
+    run the death path (re-dispatch + restart).
+  - ``PeerTimeoutError`` — the peer is alive but slow past the per-call
+    deadline. NOT a death signal: a wedged worker gets killed by the
+    heartbeat monitor, not by an impatient caller.
+  - ``FrameTooLargeError`` — the declared length exceeds the bound. The
+    reader rejects on the HEADER, before allocating or reading a single
+    payload byte, so a hostile/corrupt peer can never OOM the router.
+  - ``FrameCorruptError`` — undecodable JSON or a non-object payload.
+
+After ``FrameTooLargeError``/``FrameCorruptError`` the stream offset is
+unrecoverable (we no longer know where the next frame starts) — callers
+must close the connection; both server and client do.
+
+Application-level errors cross the wire as ``{"err": {"type": ..}}``
+responses and re-raise CLIENT-side as the same typed exceptions the
+in-process engine raises (``QueueFullError``, ``SLOShedError``,
+``EngineDrainingError``, ...) so the frontends' status-code mapping
+works unchanged whether the engine is a thread away or a process away.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from building_llm_from_scratch_tpu.serving.queue import (
+    EngineDrainingError,
+    QueueFullError,
+    SLOShedError,
+)
+from building_llm_from_scratch_tpu.serving.request import RequestExpiredError
+from building_llm_from_scratch_tpu.utils.logging import setup_logger
+
+logger = setup_logger(__name__)
+
+#: Frame-size bound. Prefix-pane handoff ships KV panes (a few MB per
+#: pane at toy scale, tens of MB for real configs), so the default is
+#: generous; control traffic is a few KB. The bound is enforced on the
+#: HEADER — an oversized declaration is rejected without reading (or
+#: allocating) the payload.
+MAX_FRAME_BYTES = 256 * 1024 * 1024
+
+_HDR = struct.Struct(">I")
+
+
+class TransportError(RuntimeError):
+    """Base class for transport-layer failures."""
+
+
+class PeerGoneError(TransportError):
+    """The peer closed / reset / refused the connection: it is dead."""
+
+
+class PeerTimeoutError(TransportError):
+    """The peer did not answer within the per-call deadline (alive but
+    slow — distinct from dead)."""
+
+
+class FrameTooLargeError(TransportError):
+    """Declared frame length exceeds the bound; payload never read."""
+
+
+class FrameCorruptError(TransportError):
+    """Frame payload is not valid JSON (or not a JSON object)."""
+
+
+# application errors that cross the wire typed; each entry maps the wire
+# tag to (exception class, carries_retry_after)
+_ERR_TYPES: Dict[str, Tuple[type, bool]] = {
+    "queue_full": (QueueFullError, False),
+    "slo_shed": (SLOShedError, True),
+    "draining": (EngineDrainingError, True),
+    "expired": (RequestExpiredError, False),
+    "value_error": (ValueError, False),
+    "runtime": (RuntimeError, False),
+}
+_ERR_TAGS = {cls: tag for tag, (cls, _) in _ERR_TYPES.items()}
+
+
+def error_payload(exc: BaseException) -> dict:
+    """Serialize an exception into the wire error object."""
+    tag = _ERR_TAGS.get(type(exc))
+    if tag is None:
+        for cls, t in _ERR_TAGS.items():
+            if isinstance(exc, cls):
+                tag = t
+                break
+    err: Dict[str, Any] = {"type": tag or "runtime", "message": str(exc)}
+    retry = getattr(exc, "retry_after_s", None)
+    if retry is not None:
+        err["retry_after_s"] = retry
+    return err
+
+
+def raise_typed(err: dict) -> None:
+    """Re-raise a wire error object as its typed exception."""
+    tag = err.get("type", "runtime")
+    msg = err.get("message", "remote error")
+    cls, has_retry = _ERR_TYPES.get(tag, (RuntimeError, False))
+    if has_retry:
+        raise cls(msg, retry_after_s=err.get("retry_after_s"))
+    raise cls(msg)
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        try:
+            chunk = sock.recv(n - len(buf))
+        except socket.timeout:
+            raise PeerTimeoutError(
+                f"peer did not answer within {sock.gettimeout()}s")
+        except OSError as e:
+            raise PeerGoneError(f"peer connection lost: {e}")
+        if not chunk:
+            raise PeerGoneError(
+                "peer closed the connection"
+                + (" mid-frame" if buf else ""))
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def send_frame(sock: socket.socket, obj: dict,
+               max_frame_bytes: int = MAX_FRAME_BYTES) -> None:
+    payload = json.dumps(obj).encode("utf-8")
+    if len(payload) > max_frame_bytes:
+        raise FrameTooLargeError(
+            f"refusing to send {len(payload)}B frame "
+            f"(bound {max_frame_bytes}B)")
+    try:
+        sock.sendall(_HDR.pack(len(payload)) + payload)
+    except socket.timeout:
+        raise PeerTimeoutError(
+            f"send blocked past {sock.gettimeout()}s (peer slow)")
+    except OSError as e:
+        raise PeerGoneError(f"peer connection lost on send: {e}")
+
+
+def recv_frame(sock: socket.socket,
+               max_frame_bytes: int = MAX_FRAME_BYTES) -> dict:
+    (length,) = _HDR.unpack(_read_exact(sock, _HDR.size))
+    if length > max_frame_bytes:
+        # reject on the header — the payload is never read, so a
+        # hostile length can't make us allocate
+        raise FrameTooLargeError(
+            f"peer declared {length}B frame (bound {max_frame_bytes}B)")
+    payload = _read_exact(sock, length)
+    try:
+        obj = json.loads(payload.decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise FrameCorruptError(f"undecodable frame: {e}")
+    if not isinstance(obj, dict):
+        raise FrameCorruptError(
+            f"frame decodes to {type(obj).__name__}, expected object")
+    return obj
+
+
+class RpcClient:
+    """Serialized request/response calls over one connection.
+
+    One in-flight call at a time (``_lock``): the protocol has no
+    request ids on the response path, so ordering IS the correlation.
+    Per-call timeouts via ``settimeout``; a timeout raises
+    ``PeerTimeoutError`` and poisons the connection (the late response
+    would desynchronize correlation), so the client closes it.
+    """
+
+    def __init__(self, path: str, *, timeout: float = 10.0,
+                 max_frame_bytes: int = MAX_FRAME_BYTES):
+        self.path = path
+        self.timeout = timeout
+        self.max_frame_bytes = max_frame_bytes
+        self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None     # guarded-by: _lock
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.settimeout(timeout)
+        try:
+            sock.connect(path)
+        except socket.timeout:
+            sock.close()
+            raise PeerTimeoutError(f"connect to {path} timed out")
+        except OSError as e:
+            sock.close()
+            raise PeerGoneError(f"connect to {path} failed: {e}")
+        self._sock = sock
+
+    def call(self, method: str, rpc_timeout: Optional[float] = None,
+             **args: Any) -> Any:
+        """Invoke ``method`` on the peer; returns its result object.
+        ``rpc_timeout`` overrides the client deadline for this one call
+        (named to never collide with application kwargs like ``timeout``).
+
+        Application errors re-raise typed (see ``raise_typed``);
+        transport failures raise ``TransportError`` subclasses and close
+        the connection (it is not reusable after either a timeout or a
+        framing fault).
+        """
+        poisoned = None
+        try:
+            with self._lock:
+                sock = self._sock
+                if sock is None:
+                    raise PeerGoneError("client closed")
+                sock.settimeout(self.timeout if rpc_timeout is None
+                                else rpc_timeout)
+                try:
+                    send_frame(sock, {"method": method, "args": args},
+                               self.max_frame_bytes)
+                    resp = recv_frame(sock, self.max_frame_bytes)
+                except TransportError:
+                    self._sock = None        # detach under the lock ...
+                    poisoned = sock
+                    raise
+        finally:
+            if poisoned is not None:         # ... close outside it
+                try:
+                    poisoned.close()
+                except OSError:
+                    pass
+        if "err" in resp:
+            raise_typed(resp["err"])
+        return resp.get("result")
+
+    def close(self) -> None:
+        with self._lock:
+            sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
+#: sentinel result: the handler took ownership of the socket (event
+#: subscription); the server acks and stops reading that connection
+DETACH = object()
+
+Handler = Callable[[str, dict, socket.socket], Any]
+
+
+class RpcServer:
+    """Threaded unix-socket RPC server.
+
+    ``handler(method, args, sock) -> result`` runs on the connection's
+    thread. A handler may return ``(DETACH, result)`` to take ownership
+    of the socket after the ack (the worker's event-push channel).
+    Handler exceptions become typed error responses — the server loop
+    NEVER dies on a bad request; framing faults (oversized/garbage)
+    get a best-effort error frame and the connection is closed, because
+    the stream offset is gone.
+    """
+
+    def __init__(self, path: str, handler: Handler, *,
+                 max_frame_bytes: int = MAX_FRAME_BYTES):
+        self.path = path
+        self.handler = handler
+        self.max_frame_bytes = max_frame_bytes
+        self._listener: Optional[socket.socket] = None
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._conns: set = set()                       # guarded-by: _lock
+        self._threads: list = []
+
+    def start(self) -> None:
+        listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        listener.bind(self.path)
+        listener.listen(16)
+        self._listener = listener
+        t = threading.Thread(target=self._accept_loop,
+                             name="rpc-accept", daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return                                 # listener closed
+            with self._lock:
+                self._conns.add(conn)
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 name="rpc-conn", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        detached = False
+        try:
+            while not self._stop.is_set():
+                try:
+                    frame = recv_frame(conn, self.max_frame_bytes)
+                except (PeerGoneError, PeerTimeoutError):
+                    return
+                except (FrameTooLargeError, FrameCorruptError) as e:
+                    # stream offset unrecoverable: answer typed, close
+                    try:
+                        send_frame(conn, {"err": {
+                            "type": "runtime",
+                            "message": f"bad frame: {e}"}})
+                    except TransportError:
+                        pass
+                    return
+                method = frame.get("method")
+                args = frame.get("args") or {}
+                if not isinstance(method, str) or not isinstance(args, dict):
+                    try:
+                        send_frame(conn, {"err": {
+                            "type": "value_error",
+                            "message": "malformed request frame"}})
+                        continue
+                    except TransportError:
+                        return
+                try:
+                    result = self.handler(method, args, conn)
+                except TransportError:
+                    return
+                except BaseException as e:             # typed error reply
+                    try:
+                        send_frame(conn, {"err": error_payload(e)})
+                        continue
+                    except TransportError:
+                        return
+                if isinstance(result, tuple) and len(result) == 2 \
+                        and result[0] is DETACH:
+                    try:
+                        send_frame(conn, {"result": result[1]},
+                                   self.max_frame_bytes)
+                    except TransportError:
+                        return
+                    detached = True
+                    return                             # handler owns sock
+                try:
+                    send_frame(conn, {"result": result},
+                               self.max_frame_bytes)
+                except TransportError:
+                    return
+        finally:
+            if not detached:
+                with self._lock:
+                    self._conns.discard(conn)
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        with self._lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass

@@ -1,13 +1,22 @@
 """Worker process for ``bench.py serve_fleet`` (one replica-count arm).
 
-Runs an open-loop Poisson offered-load sweep against an ``EngineRouter``
-with ``--replicas`` engine replicas, each pinned to its OWN forced-host
-CPU device (``--xla_force_host_platform_device_count``, set HERE before
-jax imports — which is why this is a subprocess: the parent bench
-process's device count is pinned by the perf-gate baselines). Replicas
-execute concurrently (XLA releases the GIL; per-device execution threads
-are independent), so aggregate completed-throughput scales with the
-replica count — the curve this worker measures.
+Runs an open-loop Poisson offered-load sweep against a fleet of
+``--replicas`` replicas, in one of two transports:
+
+  - ``--transport inproc``: an ``EngineRouter`` with every replica
+    pinned to its OWN forced-host CPU device (set before jax imports —
+    which is why this is a subprocess: the parent bench process's
+    device count is pinned by the perf-gate baselines);
+  - ``--transport process``: a ``ProcessFleet`` of supervised worker
+    SUBPROCESSES over the unix-socket RPC transport
+    (``serving/worker.py`` — the production cross-process path). Each
+    worker rebuilds GPT2-124M from the seed-deterministic spec, so the
+    arm measures transport + supervision overhead against the identical
+    in-process workload.
+
+The engine/host scaffolding lives in ``serving/worker.py``
+(``apply_host_env`` / ``EngineSpec``) — one worker implementation for
+bench and production.
 
 Prints ONE JSON line: capacity (measured when ``--cap_rps 0``), and per
 offered-load arm the offered/completed rps, shed/rejected counts and
@@ -18,7 +27,6 @@ from __future__ import annotations
 
 import argparse
 import json
-import os
 import sys
 
 
@@ -27,6 +35,8 @@ def main() -> None:
     ap.add_argument("--replicas", type=int, required=True)
     ap.add_argument("--tp", type=int, default=1)
     ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--transport", choices=("inproc", "process"),
+                    default="inproc")
     ap.add_argument("--cap_rps", type=float, default=0.0,
                     help="single-replica capacity (requests/sec) measured "
                          "by the replicas=1 arm; 0 = measure it here")
@@ -37,10 +47,11 @@ def main() -> None:
     ap.add_argument("--loads", type=str, default="0.75,1.25")
     args = ap.parse_args()
 
-    os.environ["XLA_FLAGS"] = (
-        os.environ.get("XLA_FLAGS", "")
-        + f" --xla_force_host_platform_device_count={args.devices}").strip()
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from building_llm_from_scratch_tpu.serving.worker import apply_host_env
+
+    # in-process replicas share this process -> one forced-host device
+    # per replica; cross-process workers pin their own host env
+    apply_host_env(args.devices if args.transport == "inproc" else 1)
 
     import time
 
@@ -49,9 +60,9 @@ def main() -> None:
 
     from building_llm_from_scratch_tpu.configs import get_config
     from building_llm_from_scratch_tpu.generate import _bucket
-    from building_llm_from_scratch_tpu.models import init_params
     from building_llm_from_scratch_tpu.serving import (
-        EngineRouter,
+        EngineSpec,
+        ProcessFleet,
         QueueFullError,
         SLOShedError,
         SamplingParams,
@@ -59,24 +70,39 @@ def main() -> None:
 
     dtype = "bf16" if jax.default_backend() == "tpu" else "fp32"
     cfg = get_config("GPT2", "124M", dtype=dtype)
-    params = init_params(cfg, jax.random.PRNGKey(0))
     R = args.replicas
     n_requests = args.requests_per_replica * R
     rng = np.random.default_rng(0)
     prompts = rng.integers(0, cfg.vocab_size,
                            (n_requests, args.prompt_len)).astype(np.int32)
+    max_len = _bucket(args.prompt_len + args.max_new)
+    max_queue = max(2 * args.slots, 16)
 
-    def new_router():
+    def new_fleet(n: int):
+        if args.transport == "process":
+            spec = EngineSpec(
+                model="GPT2", size="124M", dtype=dtype, seed=0,
+                tp=args.tp,
+                engine=dict(n_slots=args.slots, max_len=max_len,
+                            max_queue=max_queue,
+                            warmup_prompt_cap=args.prompt_len,
+                            metrics_every=8))
+            return ProcessFleet(spec, n,
+                                default_max_new_tokens=args.max_new
+                                ).start()
+        from building_llm_from_scratch_tpu.models import init_params
+        from building_llm_from_scratch_tpu.serving import EngineRouter
+
+        params = init_params(cfg, jax.random.PRNGKey(0))
         r = EngineRouter.build(
-            cfg, params, n_replicas=R, tp=args.tp,
-            n_slots=args.slots,
-            max_len=_bucket(args.prompt_len + args.max_new),
-            max_queue=max(2 * args.slots, 16),
+            cfg, params, n_replicas=n, tp=args.tp,
+            n_slots=args.slots, max_len=max_len, max_queue=max_queue,
             warmup_prompt_cap=args.prompt_len, metrics_every=8)
         r.warmup()
+        r.start()
         return r
 
-    out = {"replicas": R, "tp": args.tp,
+    out = {"replicas": R, "tp": args.tp, "transport": args.transport,
            "devices": jax.device_count(), "arms": {}}
 
     cap_rps = args.cap_rps
@@ -84,26 +110,25 @@ def main() -> None:
         # closed-loop single-replica capacity: one replica's slots
         # decoded flat out — the per-replica saturation point every
         # arm's offered load is expressed against
-        router = new_router()
-        eng = router.engines[0]
+        fleet = new_fleet(1)
         sp = SamplingParams(max_new_tokens=args.max_new, ignore_eos=True)
         t0 = time.perf_counter()
-        for p in prompts[: args.slots]:
-            eng.submit(p, sp, block=True)
-        eng.run_until_idle()
+        handles = [fleet.submit(p, sp, block=True)
+                   for p in prompts[: args.slots]]
+        for h in handles:
+            h.result(timeout=600)
         cap_tok_s = (args.slots * args.max_new
                      / (time.perf_counter() - t0))
         cap_rps = cap_tok_s / args.max_new
         out["capacity"] = {"tok_s": round(cap_tok_s, 1),
                            "rps": round(cap_rps, 4)}
-        router.shutdown()
+        fleet.shutdown()
     out["cap_rps"] = round(cap_rps, 4)
 
     for load in (float(x) for x in args.loads.split(",")):
         lam = load * cap_rps * R             # offered vs FLEET capacity
         arrivals = np.cumsum(rng.exponential(1.0 / lam, n_requests))
-        router = new_router()
-        router.start()
+        fleet = new_fleet(R)
         handles, shed, rejected = [], 0, 0
         t0 = time.perf_counter()
         for i, (p, at) in enumerate(zip(prompts, arrivals)):
@@ -111,7 +136,7 @@ def main() -> None:
             if delay > 0:
                 time.sleep(delay)
             try:
-                handles.append(router.submit(p, SamplingParams(
+                handles.append(fleet.submit(p, SamplingParams(
                     max_new_tokens=args.max_new, ignore_eos=True,
                     seed=i)))
             except SLOShedError:
@@ -126,19 +151,19 @@ def main() -> None:
             except RuntimeError:
                 pass
         dt = time.perf_counter() - t0
-        router.shutdown()
-        stats = router.stats()
+        stats = fleet.stats()
+        fleet.shutdown()
         arm = {
             "offered_rps": round(lam, 4),
             "completed_rps": round(done / dt, 4),
             "completed_tok_s": round(done * args.max_new / dt, 1),
             "done": done, "shed": shed, "rejected": rejected,
             "shed_rate": round((shed + rejected) / n_requests, 3),
-            "recompiles": stats["n_recompiles"],
-            "routed_total": stats["routed_total"],
-            "routed_spill": stats["routed_spill"],
+            "recompiles": stats.get("n_recompiles", 0),
+            "routed_total": stats.get("routed_total", 0),
+            "routed_spill": stats.get("routed_spill", 0),
         }
-        for rep in stats["replicas"]:
+        for rep in stats.get("replicas", []):
             for key in ("ttft_s", "tpot_s", "e2e_s"):
                 if key in rep:
                     arm.setdefault(key, rep[key])    # replica-0 view

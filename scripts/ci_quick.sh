@@ -560,6 +560,137 @@ render_out=$(JAX_PLATFORMS=cpu python scripts/summarize_metrics.py \
 echo "$render_out" | grep -q "scale-out serving fleet" || exit 1
 echo "fleet renderer ok"
 
+echo "== cross-process fleet smoke (2 worker processes, kill -9, restart, CPU) =="
+JAX_PLATFORMS=cpu python - <<'EOF' || exit 1
+import json, os, signal, socket, subprocess, sys, tempfile, time, glob
+import threading, urllib.request, urllib.error
+d = tempfile.mkdtemp()
+# REAL CLI serve with --serve_workers 2: two supervised worker PROCESSES
+# (each rebuilding the --debug engine from its EngineSpec) behind the
+# unix-socket RPC transport. Mid-run, one worker takes a kill -9 (pid
+# straight from /healthz): every HTTP request must come back 200 or
+# typed worker_dead (zero silently lost), the survivor must serve with
+# ZERO recompiles, and the dead worker must restart, rejoin dispatch,
+# and serve again before the clean SIGTERM exit.
+mj = os.path.join(d, "metrics.jsonl")
+s = socket.socket(); s.bind(("127.0.0.1", 0))
+port = s.getsockname()[1]; s.close()
+proc = subprocess.Popen(
+    [sys.executable, "-m", "building_llm_from_scratch_tpu",
+     "--mode", "serve", "--debug", "--byte_tokenizer", "--data_dir", d,
+     "--serve_workers", "2", "--serve_slots", "2",
+     "--serve_max_queue", "16", "--serve_port", str(port),
+     "--serve_max_new_tokens", "8",
+     "--drain_timeout", "120", "--metrics_jsonl", mj],
+    stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    env=dict(os.environ, JAX_PLATFORMS="cpu"))
+
+def healthz(timeout=10):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+def wait_fleet(pred, what, deadline_s=300):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        assert proc.poll() is None, (
+            f"serve exited rc={proc.returncode} waiting for {what}:\n"
+            + proc.stdout.read())
+        try:
+            hz = healthz()
+            if pred(hz):
+                return hz
+        except (OSError, urllib.error.URLError):
+            pass
+        time.sleep(0.25)
+    raise AssertionError(f"timed out waiting for {what}")
+
+hz = wait_fleet(lambda h: h.get("status") == "serving"
+                and h.get("workers_up") == 2, "2 workers serving")
+pids = {r["replica"]: r["pid"] for r in hz["replicas"]}
+
+def post(rec, out, i):
+    body = json.dumps(rec).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/generate", data=body,
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=300) as r:
+            out[i] = (r.status, json.loads(r.read().decode()))
+    except urllib.error.HTTPError as e:
+        out[i] = (e.code, json.loads(e.read().decode()))
+    except Exception as e:                      # noqa: BLE001
+        out[i] = (None, {"error": f"LOST: {e!r}"})
+
+# phase 1: 10 concurrent requests, then kill -9 one worker mid-decode
+results = {}
+threads = [threading.Thread(target=post, args=(
+    {"prompt": "abcd"[: 1 + i % 4], "max_new_tokens": 8,
+     "ignore_eos": True, "seed": i}, results, i), daemon=True)
+    for i in range(10)]
+for t in threads:
+    t.start()
+time.sleep(0.15)                                # let decode start
+victim = next(r["replica"] for r in healthz()["replicas"]
+              if r["status"] == "serving")
+os.kill(pids[victim], signal.SIGKILL)
+for t in threads:
+    t.join(timeout=300)
+assert len(results) == 10, f"lost responses: {sorted(results)}"
+ok = [i for i, (st, _) in results.items() if st == 200]
+died = [i for i, (st, b) in results.items()
+        if st != 200 and "worker_dead" in str(b.get("error", ""))]
+other = [results[i] for i in results if i not in ok and i not in died]
+assert not other, f"untyped failures: {other}"
+for i in ok:
+    assert results[i][1].get("token_ids"), results[i]
+
+# phase 2: the dead worker restarts, rejoins, and the fleet serves again
+hz = wait_fleet(lambda h: h.get("workers_up") == 2
+                and h.get("status") == "serving",
+                "killed worker to restart and rejoin")
+row = next(r for r in hz["replicas"] if r["replica"] == victim)
+assert row["status"] == "serving" and row["restarts"] >= 1, row
+assert row["pid"] != pids[victim], "healthz still shows the dead pid"
+post_res = {}
+post({"prompt": "abc", "max_new_tokens": 8, "ignore_eos": True},
+     post_res, 0)
+assert post_res[0][0] == 200, f"post-restart request failed: {post_res}"
+
+proc.send_signal(signal.SIGTERM)
+stdout, _ = proc.communicate(timeout=300)
+assert proc.returncode == 0, f"serve rc={proc.returncode}:\n{stdout}"
+
+rows = [json.loads(l) for l in open(mj)]
+events = [r for r in rows if r.get("type") == "event"]
+kinds = [e["event"] for e in events]
+assert kinds.count("worker_dead") == 1, kinds
+assert "worker_restart" in kinds, kinds
+assert kinds.count("worker_spawn") >= 3, kinds   # 2 boots + 1 restart
+dead = next(e for e in events if e["event"] == "worker_dead")
+assert dead["replica"] == victim and dead["pid"] == pids[victim], dead
+# zero recompiles anywhere: scan every worker's own metrics JSONL
+# (append-mode, so worker <victim>'s file holds both incarnations —
+# neither the survivor, the victim, nor its replacement may recompile
+# after their own warmups)
+recompiles = []
+for wf in sorted(glob.glob(mj + ".worker*.jsonl")):
+    wrows = [json.loads(l) for l in open(wf)]
+    recompiles += [r for r in wrows if r.get("event") == "recompile"]
+assert not recompiles, f"worker recompiled: {recompiles}"
+import shutil
+shutil.copy(mj, "/tmp/_ci_crossproc_metrics.jsonl")
+print(f"cross-process fleet smoke ok: {len(ok)}/10 completed, "
+      f"{len(died)} failed typed worker_dead, 0 lost; worker {victim} "
+      f"kill -9 -> restarted pid {row['pid']} and served again; "
+      "0 worker recompiles")
+EOF
+# renderer grows the worker-lifecycle section on the smoke's telemetry
+render_out=$(JAX_PLATFORMS=cpu python scripts/summarize_metrics.py \
+    /tmp/_ci_crossproc_metrics.jsonl) || exit 1
+echo "$render_out" | grep -q "cross-process fleet workers" || exit 1
+echo "worker-lifecycle renderer ok"
+
 echo "== perf observatory gate (structural, timing-free, CPU) =="
 # The three debug-size micro-benches' structural HLO fingerprints —
 # per-program cost-analysis FLOPs, compiled-program count, arg

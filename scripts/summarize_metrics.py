@@ -185,6 +185,7 @@ def summarize_serving(metrics, events):
              else "") + ")")
     summarize_serving_resilience(failed, shed, expired, events)
     summarize_serving_fleet(done, metrics, events)
+    summarize_worker_lifecycle(events)
     summarize_adapters(done, failed, events)
     summarize_prefix_kv(metrics, events)
     summarize_spec(done, metrics, events)
@@ -258,6 +259,59 @@ def summarize_serving_fleet(done, metrics, events):
     if restarts:
         print(f"    replica restarts: "
               f"{sorted({e.get('replica') for e in restarts})}")
+
+
+def summarize_worker_lifecycle(events):
+    """Cross-process fleet section (serving/fleet.py): worker-process
+    spawn/death/restart timeline (relative seconds from the first spawn),
+    death reasons with how much queued work was re-dispatched vs failed
+    in-flight, missed-heartbeat detections, and prefix-pane handoffs with
+    their byte volume."""
+    kinds = ("worker_spawn", "worker_heartbeat_missed", "worker_dead",
+             "worker_restart", "pane_handoff")
+    rows = [e for e in events if e["event"] in kinds]
+    if not rows:
+        return
+    print("  -- cross-process fleet workers --")
+    spawns = [e for e in rows if e["event"] == "worker_spawn"]
+    deaths = [e for e in rows if e["event"] == "worker_dead"]
+    restarts = [e for e in rows if e["event"] == "worker_restart"]
+    missed = [e for e in rows if e["event"] == "worker_heartbeat_missed"]
+    handoffs = [e for e in rows if e["event"] == "pane_handoff"]
+    replicas = sorted({e.get("replica") for e in spawns})
+    print(f"    {len(spawns)} worker spawn(s) across replicas {replicas}"
+          f" — {len(deaths)} death(s), {len(restarts)} restart(s)"
+          + (f", {len(missed)} missed-heartbeat detection(s)"
+             if missed else ""))
+    t0 = min((e.get("time", 0.0) for e in rows), default=0.0)
+    for e in rows:                       # rows keep file (= time) order
+        t = e.get("time", 0.0) - t0
+        if e["event"] == "worker_spawn":
+            print(f"    t+{t:7.2f}s  replica {e.get('replica')} spawned "
+                  f"pid {e.get('pid')}"
+                  + (f" (restart #{e.get('restarts')})"
+                     if e.get("restarts") else ""))
+        elif e["event"] == "worker_dead":
+            print(f"    t+{t:7.2f}s  replica {e.get('replica')} DIED "
+                  f"({e.get('reason')}): "
+                  f"{e.get('queued_redispatched', 0)} queued re-dispatched"
+                  f", {e.get('inflight_failed', 0)} in-flight failed typed")
+        elif e["event"] == "worker_restart":
+            down = e.get("downtime_s")
+            print(f"    t+{t:7.2f}s  replica {e.get('replica')} restarted"
+                  f" (#{e.get('restarts')}"
+                  + (f", {down:.2f}s downtime" if down is not None else "")
+                  + ")")
+        elif e["event"] == "pane_handoff":
+            print(f"    t+{t:7.2f}s  panes {e.get('from_replica')} -> "
+                  f"{e.get('to_replica')}: {e.get('imported', 0)}/"
+                  f"{e.get('entries', 0)} entries, "
+                  f"{e.get('bytes', 0):,} bytes")
+    if handoffs:
+        total = sum(e.get("bytes") or 0 for e in handoffs)
+        print(f"    pane handoff total: {len(handoffs)} transfer(s), "
+              f"{total:,} bytes (adoptees serve shared prefixes "
+              "without recompute)")
 
 
 def summarize_adapters(done, failed, events):

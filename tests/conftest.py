@@ -18,6 +18,7 @@ skip on the virtual CPU mesh:
 """
 
 import os
+import tempfile
 
 RUN_ON_TPU = os.environ.get("RUN_TPU_TESTS") == "1"
 
@@ -33,8 +34,15 @@ import jax  # noqa: E402
 if not RUN_ON_TPU:
     jax.config.update("jax_platforms", "cpu")
 
+# NOTE on the XLA persistent compilation cache: do NOT enable it for this
+# suite. On this jaxlib CPU build, executables deserialized from the cache
+# lose their donation/alias metadata (memory_analysis alias bytes come back
+# 0, corrupting the perf fingerprints) and a subsequent donated-buffer
+# execution aborts the process (SIGABRT reproduced via test_cli_resume).
+# run_bench additionally pins cold-compile semantics for fingerprints even
+# when a cache is ambiently configured.
+
 import contextlib  # noqa: E402
-import tempfile  # noqa: E402
 
 import pytest  # noqa: E402
 

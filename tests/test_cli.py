@@ -63,6 +63,7 @@ def test_cli_finetune_lora_end_to_end(data_dir, tmp_path):
     assert os.path.exists(os.path.join(out, "model_pg_final.npz"))
 
 
+@pytest.mark.slow
 def test_cli_multichip_fsdp(data_dir, tmp_path):
     """--run_type multi_chip shards state over the full 8-device mesh."""
     out = str(tmp_path / "out_mc")

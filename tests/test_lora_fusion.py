@@ -259,6 +259,7 @@ def test_join_finish_zero_recompile_and_deploy(cfg, base_params, tmp_path):
     assert registry.n_loaded == 3
 
 
+@pytest.mark.slow
 def test_nonfinite_job_retires_alone_coresidents_bit_identical(
         cfg, base_params, tmp_path):
     """Poisoning job B's adapter row mid-run retires B (no artifact, a
@@ -594,6 +595,7 @@ def _tracked_run(engine, record, stop_at=None, signal_at=None):
     return engine
 
 
+@pytest.mark.slow
 def test_fleet_sigterm_resume_bit_for_bit(cfg, base_params, tmp_path):
     """SIGTERM mid-fleet -> step-boundary checkpoint -> `--resume auto`
     discovery -> per-job loss trajectories continue BIT-FOR-BIT: the

@@ -1,0 +1,356 @@
+"""Cross-process fleet tests (serving/fleet.py + serving/worker.py):
+supervised worker SUBPROCESSES behind the engine-shaped ``ProcessFleet``
+facade. The fault-injection contract: kill -9 a worker mid-decode under
+live traffic and every submitted request either completes or fails with
+a TYPED ``worker_dead`` error — zero silently lost, queued work
+re-dispatched onto survivors under the SAME ``Request`` handles,
+survivors never recompile, the dead worker restarts within its backoff
+budget and rejoins dispatch. Plus: ``/healthz`` reports ``degraded``
+(never raises) while a worker is down, restart-budget exhaustion
+degrades the fleet to survivors instead of flapping, and graceful drain
+hands the retiring worker's prefix panes to the adoptee BYTE-IDENTICAL.
+
+All fleet tests run the jax-free ``FakeEngine`` (``spec.fake``) so each
+worker process boots in ~a second; the real-engine path is covered by
+``scripts/ci_quick.sh``'s CLI smoke and ``bench.py serve_fleet``'s
+cross-process arm."""
+
+import json
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+from building_llm_from_scratch_tpu.obs import configure_metrics
+from building_llm_from_scratch_tpu.serving import (
+    EngineSpec,
+    ProcessFleet,
+    SamplingParams,
+)
+
+@pytest.fixture
+def sink(tmp_path):
+    path = tmp_path / "metrics.jsonl"
+    logger = configure_metrics(str(path), run_metadata={"test": True})
+    yield str(path)
+    logger.close()
+    configure_metrics(None)
+
+
+def load_events(path):
+    rows = [json.loads(line) for line in open(path)]
+    return [r for r in rows if r.get("type") == "event"]
+
+
+def fake_spec(**fake_kw):
+    fake = dict(n_slots=2, max_queue=32, tpot_s=0.01,
+                default_max_new_tokens=8, vocab_size=96)
+    fake.update(fake_kw)
+    return EngineSpec(fake=fake)
+
+
+def make_fleet(n=2, tmp_path=None, spec=None, **kw):
+    kw.setdefault("heartbeat_s", 0.1)
+    kw.setdefault("heartbeat_timeout_s", 5.0)
+    kw.setdefault("max_restarts", 2)
+    kw.setdefault("restart_backoff_s", 0.2)
+    kw.setdefault("ready_timeout_s", 120.0)
+    if tmp_path is not None:
+        kw.setdefault("socket_dir", str(tmp_path / "socks"))
+        os.makedirs(kw["socket_dir"], exist_ok=True)
+    return ProcessFleet(spec or fake_spec(), n, **kw)
+
+
+def expected_tokens(prompt, n):
+    """FakeEngine's deterministic rule: token i = (prompt[-1] + i) % 96
+    — identical wherever the request runs, so a re-dispatched handle is
+    checkable against the same reference."""
+    last = int(prompt[-1])
+    return [(last + i) % 96 for i in range(n)]
+
+
+def wait_for(pred, timeout, what):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+@pytest.mark.slow
+def test_fleet_serves_across_processes(tmp_path, sink):
+    fleet = make_fleet(2, tmp_path).start()
+    try:
+        prompts = [np.array([3 + i, 7 + i], np.int32) for i in range(6)]
+        handles = [fleet.submit(p, SamplingParams(max_new_tokens=8),
+                                block=True, timeout=10.0)
+                   for p in prompts]
+        for p, h in zip(prompts, handles):
+            h.result(timeout=30.0)
+            assert h.output_ids == expected_tokens(p, 8)
+            assert h.finish_reason == "length"
+            assert h.route and "replica" in h.route
+        hz = fleet.healthz_payload()
+        assert hz["status"] == "serving"
+        assert hz["workers_up"] == 2
+        assert {r["status"] for r in hz["replicas"]} == {"serving"}
+        assert fleet.stats()["requests_finished"] == 6
+        assert fleet.n_recompiles == 0
+        text = fleet.prometheus_text()
+        assert "fleet_workers_up 2" in text
+    finally:
+        fleet.shutdown(drain=False)
+    events = [e["event"] for e in load_events(sink)]
+    assert events.count("worker_spawn") == 2
+    assert "serve_fleet" in events
+
+
+@pytest.mark.slow
+def test_kill9_mid_decode_zero_lost_typed_failures_restart(tmp_path, sink):
+    """The tentpole acceptance test. kill -9 one worker mid-decode with
+    a full queue behind it: every handle resolves (zero lost), in-flight
+    work fails TYPED with worker_dead, queued work re-dispatches onto
+    the survivor under the ORIGINAL handles, the survivor never
+    recompiles, and the dead worker restarts and serves again."""
+    spec = fake_spec(tpot_s=0.05, n_slots=2)
+    fleet = make_fleet(2, tmp_path, spec=spec).start()
+    try:
+        prompts = [np.array([10 + i], np.int32) for i in range(12)]
+        handles = [fleet.submit(p, SamplingParams(max_new_tokens=8),
+                                block=True, timeout=10.0)
+                   for p in prompts]
+        by_id = {h.id: p for h, p in zip(handles, prompts)}
+        time.sleep(0.15)                       # let decode start
+        hz = fleet.healthz_payload()
+        victim_idx = next(r["replica"] for r in hz["replicas"]
+                          if r["status"] == "serving")
+        victim_pid = fleet.workers[victim_idx].pid
+        os.kill(victim_pid, signal.SIGKILL)
+
+        ok, failed, lost = [], [], []
+        for h in handles:
+            try:
+                h.result(timeout=60.0)
+                ok.append(h)
+            except RuntimeError as e:
+                assert "worker_dead" in str(e), (
+                    f"death must surface typed, got: {e}")
+                failed.append(h)
+            except Exception as e:              # noqa: BLE001
+                lost.append((h, e))
+        assert not lost, f"untypted/lost handles: {lost}"
+        assert len(ok) + len(failed) == 12
+        assert ok, "survivor should have completed redispatched work"
+        for h in ok:                            # same handle, same tokens
+            assert h.output_ids == expected_tokens(by_id[h.id], 8)
+
+        st = fleet.stats()
+        assert st["worker_deaths"] == 1
+        assert st["failed_on_death"] == len(failed)
+        assert st["redispatched_total"] >= 1
+        assert fleet.n_recompiles == 0, "survivors must not recompile"
+
+        wait_for(lambda: fleet.stats()["worker_restarts"] == 1, 30.0,
+                 "the dead worker to restart")
+        wait_for(lambda: fleet.healthz_payload()["status"] == "serving",
+                 10.0, "fleet to report serving again")
+        # the restarted worker is back in dispatch: fill BOTH workers
+        # past one worker's slot+queue capacity and everything completes
+        p = np.array([55], np.int32)
+        post = [fleet.submit(p, SamplingParams(max_new_tokens=4),
+                             block=True, timeout=10.0) for _ in range(8)]
+        for h in post:
+            h.result(timeout=30.0)
+            assert h.output_ids == expected_tokens(p, 4)
+    finally:
+        fleet.shutdown(drain=False)
+
+    events = load_events(sink)
+    kinds = [e["event"] for e in events]
+    assert "worker_dead" in kinds
+    assert "worker_restart" in kinds
+    assert "router_redispatch" in kinds
+    dead = next(e for e in events if e["event"] == "worker_dead")
+    assert dead["replica"] == victim_idx
+    assert dead["pid"] == victim_pid
+    restart = next(e for e in events if e["event"] == "worker_restart")
+    assert restart["replica"] == victim_idx
+    assert restart["restarts"] == 1
+
+
+@pytest.mark.slow
+def test_healthz_degraded_during_outage_and_never_raises(tmp_path, sink):
+    fleet = make_fleet(2, tmp_path,
+                       restart_backoff_s=1.0).start()   # slow restart:
+    try:                                     # a wide window to observe
+        os.kill(fleet.workers[0].pid, signal.SIGKILL)
+        wait_for(lambda: fleet.healthz_payload()["status"] == "degraded",
+                 10.0, "degraded health after kill")
+        # health is built from cached snapshots — no RPC, so hammering
+        # it during the outage can neither raise nor stall
+        t0 = time.monotonic()
+        for _ in range(50):
+            hz = fleet.healthz_payload()
+            assert hz["status"] in ("degraded", "serving")
+        assert time.monotonic() - t0 < 1.0
+        row = next(r for r in hz["replicas"] if r["replica"] == 0)
+        assert row["status"] in ("restarting", "serving")
+        # the survivor keeps serving while its neighbor is down
+        h = fleet.submit(np.array([5], np.int32),
+                         SamplingParams(max_new_tokens=4), block=True,
+                         timeout=10.0)
+        h.result(timeout=30.0)
+        wait_for(lambda: fleet.healthz_payload()["status"] == "serving",
+                 30.0, "restarted worker to rejoin")
+        assert fleet.healthz_payload()["workers_up"] == 2
+    finally:
+        fleet.shutdown(drain=False)
+
+
+@pytest.mark.slow
+def test_restart_budget_exhaustion_degrades_to_survivors(tmp_path, sink):
+    fleet = make_fleet(2, tmp_path, max_restarts=0).start()
+    try:
+        os.kill(fleet.workers[0].pid, signal.SIGKILL)
+        wait_for(lambda: fleet.workers[0].stopped, 10.0,
+                 "budget-exhausted worker marked stopped")
+        hz = fleet.healthz_payload()
+        assert hz["status"] == "degraded"
+        assert next(r for r in hz["replicas"]
+                    if r["replica"] == 0)["status"] == "dead"
+        assert fleet.stats()["worker_restarts"] == 0
+        # degraded, not down: the survivor serves indefinitely
+        for _ in range(3):
+            h = fleet.submit(np.array([9], np.int32),
+                             SamplingParams(max_new_tokens=4),
+                             block=True, timeout=10.0)
+            h.result(timeout=30.0)
+        time.sleep(0.5)                       # no flapping restarts
+        assert fleet.stats()["worker_restarts"] == 0
+    finally:
+        fleet.shutdown(drain=False)
+    assert "worker_restart" not in [e["event"] for e in load_events(sink)]
+
+
+@pytest.mark.slow
+def test_pane_handoff_byte_identical_and_adoptee_hits(tmp_path, sink):
+    """Drain a worker that accumulated prefix panes: the survivor must
+    import them byte-for-byte (keys are config-fingerprinted, identical
+    across same-spec workers) and then serve the shared prefix as a
+    prefix_hit — no recompute."""
+    spec = fake_spec(prefix_chunk=4)
+    fleet = make_fleet(2, tmp_path, spec=spec).start()
+    try:
+        shared = np.arange(8, dtype=np.int32)        # two full chunks
+        for tail in (91, 92, 93):
+            h = fleet.submit(np.concatenate([shared, [tail]]).astype(
+                np.int32), SamplingParams(max_new_tokens=2),
+                block=True, timeout=10.0)
+            h.result(timeout=30.0)
+        donor = next(i for i in range(2)
+                     if (fleet.workers[i].ctrl.call("stats")
+                         .get("prefix_store", {}).get("entries", 0)))
+        adoptee = 1 - donor
+        exported = fleet.workers[donor].ctrl.call("export_panes")
+        assert exported["entries"], "donor accumulated no panes"
+        before = fleet.workers[adoptee].ctrl.call("stats").get(
+            "prefix_store", {})
+
+        out = fleet.drain_worker(donor, timeout=10.0, handoff_to=adoptee)
+        assert out["drained"]
+
+        got = fleet.workers[adoptee].ctrl.call("export_panes")
+        by_key = {e["key"]: e for e in got["entries"]}
+        for ent in exported["entries"]:
+            twin = by_key.get(ent["key"])
+            assert twin is not None, f"entry {ent['key']} not adopted"
+            assert twin["panes"] == ent["panes"], (
+                "pane bytes changed in transit")   # b64 equality = bytes
+            assert twin["span"] == ent["span"]
+
+        # adoptee now serves the donor's prefix: hit, not recompute
+        hits0 = fleet.workers[adoptee].ctrl.call("stats")[
+            "prefix_store"]["hits"]
+        h = fleet.submit(np.concatenate([shared, [94]]).astype(np.int32),
+                         SamplingParams(max_new_tokens=2), block=True,
+                         timeout=10.0)
+        h.result(timeout=30.0)
+        after = fleet.workers[adoptee].ctrl.call("stats")["prefix_store"]
+        assert after["hits"] == hits0 + 1
+        assert after["misses"] == before.get("misses", 0), (
+            "adopted prefix must not be recomputed as a miss")
+    finally:
+        fleet.shutdown(drain=False)
+    events = load_events(sink)
+    hand = [e for e in events if e["event"] == "pane_handoff"]
+    assert len(hand) == 1
+    assert hand[0]["from_replica"] == donor
+    assert hand[0]["to_replica"] == adoptee
+    assert hand[0]["imported"] == len(exported["entries"])
+    assert hand[0]["bytes"] > 0
+
+
+@pytest.mark.slow
+def test_rolling_drain_completes_queued_work(tmp_path, sink):
+    fleet = make_fleet(2, tmp_path).start()
+    try:
+        p = np.array([40], np.int32)
+        handles = [fleet.submit(p, SamplingParams(max_new_tokens=6),
+                                block=True, timeout=10.0)
+                   for _ in range(8)]
+        out = fleet.drain(timeout=20.0)
+        assert out["seconds"] < 20.0
+        for h in handles:                      # drain loses nothing
+            h.result(timeout=30.0)
+            assert h.output_ids == expected_tokens(p, 6)
+        assert fleet.draining
+        with pytest.raises(Exception):
+            fleet.submit(p, SamplingParams(max_new_tokens=2))
+    finally:
+        fleet.shutdown(drain=False)
+
+
+@pytest.mark.slow
+def test_shutdown_fails_leftovers_instead_of_hanging(tmp_path, sink):
+    spec = fake_spec(tpot_s=0.2)              # slow: work still queued
+    fleet = make_fleet(1, tmp_path, spec=spec).start()
+    h = fleet.submit(np.array([1], np.int32),
+                     SamplingParams(max_new_tokens=64), block=True,
+                     timeout=10.0)
+    fleet.shutdown(drain=False)
+    assert h.done
+    with pytest.raises(Exception):
+        h.result(timeout=1.0)
+
+
+def test_stray_serve_workers_flag_guarded():
+    from building_llm_from_scratch_tpu.args import get_args
+
+    with pytest.raises(ValueError, match="serve_workers"):
+        get_args(["--data_dir", "/tmp", "--serve_workers", "2"])
+
+
+def test_serve_workers_arg_validation():
+    from building_llm_from_scratch_tpu.args import get_args
+
+    base = ["--data_dir", "/tmp", "--mode", "serve",
+            "--serve_port", "8080", "--serve_workers", "2"]
+    args = get_args(base)
+    assert args.serve_workers == 2
+    with pytest.raises(ValueError, match="serve_replicas"):
+        get_args(base + ["--serve_replicas", "2"])
+    with pytest.raises(ValueError, match="load_weights"):
+        get_args(base + ["--load_weights"])
+
+
+def test_engine_spec_json_roundtrip():
+    spec = EngineSpec(model="GPT2", size="355M", dtype="fp32", seed=7,
+                      tokenizer="byte", tp=2,
+                      engine={"n_slots": 4, "max_len": 128},
+                      kv_policy={"prefix_cache": True},
+                      adapters={"a": "/tmp/a.npz"}, spec_k=3)
+    back = EngineSpec.from_json(spec.to_json())
+    assert back == spec

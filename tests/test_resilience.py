@@ -309,6 +309,7 @@ def test_watchdog_halts_on_nonfinite_and_spike():
     wd2.observe(1, 100.0)
 
 
+@pytest.mark.slow
 def test_trainer_watchdog_halts_on_diverged_loss(tmp_path):
     """End-to-end: a poisoned step metric stops training with a diagnostic
     instead of running to completion."""
@@ -362,6 +363,7 @@ class InterruptingLoader(PretrainLoader):
         return gen()
 
 
+@pytest.mark.slow
 def test_keyboard_interrupt_checkpoint_roundtrips_and_resumes(tmp_path):
     """Satellite: KeyboardInterrupt mid-_run_epoch writes a checkpoint that
     round-trips through load_checkpoint and resumes at the right step."""
@@ -478,6 +480,7 @@ def test_preemption_stop_emits_event(tmp_path, event_sink):
                for e in events)
 
 
+@pytest.mark.slow
 def test_graceful_stop_resume_matches_uninterrupted_run(tmp_path):
     """The tentpole invariant, in-process: stop at a step boundary, resume
     via the data cursor, and the remaining eval-loss trajectory is

@@ -129,6 +129,7 @@ def test_slo_shed_decision_math(model):
 # per-request fault isolation
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_poison_prefill_fails_alone_coresidents_bit_identical(model):
     """THE isolation contract: a poison request (injected prefill fault)
     fails alone, and its co-residents' token streams are bit-identical to
@@ -256,6 +257,7 @@ def test_out_of_vocab_prompt_rejected_at_submit(model):
 # tick-watchdog supervisor
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_hung_tick_flight_record_restart_then_serve(model, tmp_path):
     """A wedged tick trips the watchdog: flight record (``stall`` event),
     in-flight requests fail, the loop restarts with bounded backoff

@@ -1,0 +1,342 @@
+"""Transport fuzz tests (serving/transport.py): every way a peer can
+misbehave on the wire — truncated frames, oversized length declarations,
+garbage JSON, death mid-frame — must surface as a TYPED error on the
+other side (``PeerGoneError`` / ``PeerTimeoutError`` /
+``FrameTooLargeError`` / ``FrameCorruptError``), never a crash, a hang,
+or an unbounded allocation; and the server must keep serving new
+connections after any of them. Application errors must round-trip typed
+(``QueueFullError`` raised in a handler re-raises as ``QueueFullError``
+in the caller, retry hints intact). No jax anywhere — this tier runs in
+milliseconds."""
+
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from building_llm_from_scratch_tpu.serving.queue import (
+    EngineDrainingError,
+    QueueFullError,
+    SLOShedError,
+)
+from building_llm_from_scratch_tpu.serving.request import RequestExpiredError
+from building_llm_from_scratch_tpu.serving.transport import (
+    DETACH,
+    FrameCorruptError,
+    FrameTooLargeError,
+    PeerGoneError,
+    PeerTimeoutError,
+    RpcClient,
+    RpcServer,
+    TransportError,
+    error_payload,
+    raise_typed,
+    recv_frame,
+    send_frame,
+)
+
+_HDR = struct.Struct(">I")
+
+
+@pytest.fixture
+def sock_pair():
+    a, b = socket.socketpair(socket.AF_UNIX, socket.SOCK_STREAM)
+    yield a, b
+    for s in (a, b):
+        try:
+            s.close()
+        except OSError:
+            pass
+
+
+def echo_server(tmp_path, handler=None):
+    path = str(tmp_path / "rpc.sock")
+
+    def default(method, args, sock):
+        if method == "echo":
+            return args
+        if method == "boom_queue":
+            raise QueueFullError("queue full (remote)")
+        if method == "boom_shed":
+            raise SLOShedError("shed (remote)", retry_after_s=1.5)
+        if method == "boom_drain":
+            raise EngineDrainingError("draining (remote)",
+                                      retry_after_s=0.5)
+        if method == "boom_expired":
+            raise RequestExpiredError("expired (remote)")
+        if method == "boom_value":
+            raise ValueError("bad arg (remote)")
+        if method == "slow":
+            time.sleep(args.get("s", 1.0))
+            return "late"
+        if method == "detach":
+            return (DETACH, "detached")
+        raise RuntimeError(f"no such method {method}")
+
+    srv = RpcServer(path, handler or default)
+    srv.start()
+    return path, srv
+
+
+# -- framing -----------------------------------------------------------------
+
+
+def test_frame_roundtrip(sock_pair):
+    a, b = sock_pair
+    send_frame(a, {"x": 1, "y": ["a", None, 2.5]})
+    assert recv_frame(b) == {"x": 1, "y": ["a", None, 2.5]}
+
+
+def test_oversized_send_refused(sock_pair):
+    a, _ = sock_pair
+    with pytest.raises(FrameTooLargeError):
+        send_frame(a, {"blob": "z" * 4096}, max_frame_bytes=1024)
+
+
+def test_oversized_header_rejected_without_reading_payload(sock_pair):
+    """A hostile 3GiB length declaration is rejected ON the header —
+    the receiver never tries to read (or allocate) the payload, so the
+    sender's unsent bytes are irrelevant."""
+    a, b = sock_pair
+    a.sendall(_HDR.pack(3 * 1024 ** 3) + b"only-a-few-bytes")
+    with pytest.raises(FrameTooLargeError, match="declared"):
+        recv_frame(b)
+
+
+def test_truncated_frame_is_peer_gone(sock_pair):
+    a, b = sock_pair
+    a.sendall(_HDR.pack(100) + b"only 20 of 100 bytes")
+    a.close()
+    with pytest.raises(PeerGoneError, match="mid-frame"):
+        recv_frame(b)
+
+
+def test_truncated_header_is_peer_gone(sock_pair):
+    a, b = sock_pair
+    a.sendall(b"\x00\x00")                       # 2 of 4 header bytes
+    a.close()
+    with pytest.raises(PeerGoneError):
+        recv_frame(b)
+
+
+def test_clean_eof_is_peer_gone(sock_pair):
+    a, b = sock_pair
+    a.close()
+    with pytest.raises(PeerGoneError):
+        recv_frame(b)
+
+
+@pytest.mark.parametrize("payload", [
+    b"not json at all {{{",
+    b"\xff\xfe\x00garbage bytes",
+    b"[1, 2, 3]",                                # valid JSON, not an object
+    b'"just a string"',
+])
+def test_garbage_payload_is_frame_corrupt(sock_pair, payload):
+    a, b = sock_pair
+    a.sendall(_HDR.pack(len(payload)) + payload)
+    with pytest.raises(FrameCorruptError):
+        recv_frame(b)
+
+
+def test_recv_timeout_is_peer_timeout(sock_pair):
+    _, b = sock_pair
+    b.settimeout(0.05)
+    with pytest.raises(PeerTimeoutError):
+        recv_frame(b)
+
+
+# -- typed application errors ------------------------------------------------
+
+
+def test_error_payload_roundtrip_all_types():
+    for exc in (QueueFullError("q"), SLOShedError("s", retry_after_s=2.0),
+                EngineDrainingError("d", retry_after_s=0.1),
+                RequestExpiredError("e"), ValueError("v"),
+                RuntimeError("r")):
+        with pytest.raises(type(exc)) as ei:
+            raise_typed(error_payload(exc))
+        assert str(exc) in str(ei.value)
+    assert pytest.raises(SLOShedError, raise_typed,
+                         error_payload(SLOShedError("s", retry_after_s=2.0))
+                         ).value.retry_after_s == 2.0
+
+
+def test_error_payload_subclass_maps_to_nearest_tag():
+    class CustomQueueFull(QueueFullError):
+        pass
+
+    assert error_payload(CustomQueueFull("x"))["type"] == "queue_full"
+
+
+def test_unknown_error_tag_degrades_to_runtime():
+    with pytest.raises(RuntimeError, match="mystery"):
+        raise_typed({"type": "from_the_future", "message": "mystery"})
+
+
+# -- client/server -----------------------------------------------------------
+
+
+def test_rpc_echo_and_typed_errors(tmp_path):
+    path, srv = echo_server(tmp_path)
+    try:
+        c = RpcClient(path, timeout=5.0)
+        assert c.call("echo", a=1, b="two") == {"a": 1, "b": "two"}
+        with pytest.raises(QueueFullError):
+            c.call("boom_queue")
+        with pytest.raises(SLOShedError) as ei:
+            c.call("boom_shed")
+        assert ei.value.retry_after_s == 1.5
+        with pytest.raises(EngineDrainingError) as ei:
+            c.call("boom_drain")
+        assert ei.value.retry_after_s == 0.5
+        with pytest.raises(RequestExpiredError):
+            c.call("boom_expired")
+        with pytest.raises(ValueError):
+            c.call("boom_value")
+        # typed errors do NOT poison the connection — next call works
+        assert c.call("echo", ok=True) == {"ok": True}
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_rpc_connect_to_nothing_is_peer_gone(tmp_path):
+    with pytest.raises(PeerGoneError):
+        RpcClient(str(tmp_path / "no-such.sock"))
+
+
+def test_rpc_call_timeout_is_peer_timeout_and_poisons(tmp_path):
+    path, srv = echo_server(tmp_path)
+    try:
+        c = RpcClient(path, timeout=0.1)
+        with pytest.raises(PeerTimeoutError):
+            c.call("slow", s=5.0)
+        # the late response would desync correlation: connection closed
+        with pytest.raises(PeerGoneError, match="client closed"):
+            c.call("echo")
+    finally:
+        srv.stop()
+
+
+def test_rpc_per_call_timeout_override(tmp_path):
+    path, srv = echo_server(tmp_path)
+    try:
+        c = RpcClient(path, timeout=0.1)
+        assert c.call("slow", rpc_timeout=5.0, s=0.3) == "late"
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_server_survives_garbage_connections(tmp_path):
+    """Fuzz the server with every flavor of bad client; it must answer
+    (or close) each without dying, and a well-behaved client connecting
+    AFTERWARDS must still get served."""
+    path, srv = echo_server(tmp_path)
+    try:
+        attacks = [
+            b"",                                        # connect-and-leave
+            b"\x00",                                    # truncated header
+            _HDR.pack(3 * 1024 ** 3),                   # hostile length
+            _HDR.pack(7) + b"garbage",                  # corrupt JSON
+            _HDR.pack(6) + b'[1, 2]',                   # non-object frame
+            _HDR.pack(2) + b"{}",                       # no method field
+            _HDR.pack(100) + b"short",                  # death mid-frame
+        ]
+        for raw in attacks:
+            s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            s.connect(path)
+            if raw:
+                s.sendall(raw)
+            s.close()
+        c = RpcClient(path, timeout=5.0)
+        assert c.call("echo", alive=1) == {"alive": 1}
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_server_replies_typed_on_bad_frame_when_it_can(tmp_path):
+    """A corrupt frame gets a best-effort error reply before the close —
+    a confused-but-honest client learns why instead of seeing bare EOF."""
+    path, srv = echo_server(tmp_path)
+    try:
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.connect(path)
+        s.sendall(_HDR.pack(7) + b"garbage")
+        s.settimeout(5.0)
+        resp = recv_frame(s)
+        assert "err" in resp and "bad frame" in resp["err"]["message"]
+        # ... and then the connection is closed (offset unrecoverable)
+        with pytest.raises(PeerGoneError):
+            recv_frame(s)
+        s.close()
+    finally:
+        srv.stop()
+
+
+def test_server_survives_client_death_mid_call(tmp_path):
+    """Client dies between sending a request and reading the response;
+    the connection thread must fold quietly and the server keep going."""
+    hits = []
+
+    def handler(method, args, sock):
+        hits.append(method)
+        time.sleep(0.2)
+        return "ok"
+
+    path, srv = echo_server(tmp_path, handler)
+    try:
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.connect(path)
+        send_frame(s, {"method": "die", "args": {}})
+        s.close()                                     # gone before reply
+        deadline = time.monotonic() + 5.0
+        while "die" not in hits and time.monotonic() < deadline:
+            time.sleep(0.01)
+        c = RpcClient(path, timeout=5.0)
+        assert c.call("after") == "ok"
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_detach_hands_socket_to_handler(tmp_path):
+    """(DETACH, ack) replies the ack then stops the server read loop on
+    that connection — the handler owns it for event pushes."""
+    pushed = threading.Event()
+
+    def handler(method, args, sock):
+        if method == "subscribe":
+            def pusher():
+                time.sleep(0.05)
+                send_frame(sock, {"ev": "tick"})
+                pushed.set()
+            threading.Thread(target=pusher, daemon=True).start()
+            return (DETACH, "subscribed")
+        return "ok"
+
+    path, srv = echo_server(tmp_path, handler)
+    try:
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.connect(path)
+        s.settimeout(5.0)
+        send_frame(s, {"method": "subscribe", "args": {}})
+        assert recv_frame(s) == {"result": "subscribed"}
+        assert recv_frame(s) == {"ev": "tick"}        # pushed, not polled
+        assert pushed.wait(5.0)
+        s.close()
+    finally:
+        srv.stop()
+
+
+def test_transport_errors_are_runtime_errors():
+    """Callers that only catch RuntimeError (the engine idiom) still see
+    transport faults — the hierarchy keeps old except-clauses working."""
+    for cls in (PeerGoneError, PeerTimeoutError, FrameTooLargeError,
+                FrameCorruptError):
+        assert issubclass(cls, TransportError)
+        assert issubclass(cls, RuntimeError)
