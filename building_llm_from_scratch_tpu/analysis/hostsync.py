@@ -84,6 +84,16 @@ HOT_PATHS = {
         "ProcessFleet._dispatch_order",
         "ProcessFleet._apply_event",
         "ProcessFleet.healthz_payload",
+        # the aggregated /metrics scrape must answer from cached series
+        # even mid-outage — a device fetch would stall every scrape
+        "ProcessFleet.metrics_snapshot",
+    },
+    "building_llm_from_scratch_tpu/serving/transport.py": {
+        # every fleet RPC crosses these two; timing/trace bookkeeping
+        # must stay plain host floats — a device touch would serialize
+        # the whole frame stream on one sync
+        "RpcClient.call",
+        "RpcServer._serve_conn",
     },
     "building_llm_from_scratch_tpu/data/prefetch.py": {
         "Prefetcher._fill",

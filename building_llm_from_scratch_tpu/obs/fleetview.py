@@ -1,0 +1,362 @@
+"""Fleet observatory: merge fleet + worker JSONLs into ONE timeline.
+
+A ``ProcessFleet`` run writes N+1 metrics files: the fleet's own JSONL
+(request spans, dispatch events, worker births/deaths, ``clock_sync``
+samples) and one JSONL per worker process (its engine's tick windows,
+``worker_request``/``rpc`` spans, serving events) — with one HEADER per
+incarnation stacked in the same file, because restarted workers append.
+Each process stamps rows with ITS OWN wall clock, so a naive merge puts
+a worker's prefill *before* the RPC that delivered the request whenever
+the clocks disagree.
+
+This module renders the whole set as one skew-corrected Perfetto
+timeline:
+
+  - worker rows are shifted onto the fleet's clock using the NTP-style
+    offsets the fleet measured over its RPC channel (``clock_sync``
+    events: ``offset_s`` = worker wall − fleet wall at the round-trip
+    midpoint, ``uncertainty_s`` = rtt/2 — the lowest-uncertainty sample
+    per (replica, incarnation) wins);
+  - the fleet's file renders exactly as ``obs/trace.py`` would render
+    it alone (request span trees with their ``rpc:<method>`` children,
+    incident instants), pinned to the merged clock base;
+  - each worker gets its own process track (``worker<i>``): engine tick
+    windows, per-request ``worker_request`` + ``rpc`` server spans, and
+    its incident instants, all keyed by the FLEET request id so a
+    request's router-side and worker-side spans sit on aligned tracks;
+  - Chrome flow arrows connect each fleet request span to the
+    ``worker_request`` span(s) that served it — the cross-process edge
+    is scrubbable, not inferred.
+
+CLI:  python -m building_llm_from_scratch_tpu.obs.fleetview \
+          out/metrics.jsonl [-o out/fleet_trace.json]
+(worker files are discovered as ``<fleet_jsonl>.worker*.jsonl`` — the
+``ProcessFleet`` naming convention.)
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+from building_llm_from_scratch_tpu.obs.schema import (
+    INCIDENT_EVENTS,
+    TICK_PHASES,
+)
+from building_llm_from_scratch_tpu.obs.trace import (
+    _PID_REQUESTS,
+    _instant,
+    _meta,
+    _num,
+    _window_events,
+    _x,
+    chrome_trace,
+    load_jsonl,
+)
+
+#: Worker process tracks start here (fleet tracks are pids 1..4).
+_PID_WORKER0 = 10
+
+#: Span rows on a worker track sit at ``tid = request_id + _TID_SPANS``
+#: — the offset keeps small client ids clear of the window tids (1, 2).
+_TID_SPANS = 100
+_TID_INCIDENTS = 3
+
+
+class _Segment:
+    """One worker incarnation's slice of its (append-mode) JSONL."""
+
+    __slots__ = ("replica", "incarnation", "pid", "rows", "offset_s",
+                 "uncertainty_s")
+
+    def __init__(self, replica: int, incarnation: int,
+                 pid: Optional[int], rows: List[dict]):
+        self.replica = replica
+        self.incarnation = incarnation
+        self.pid = pid
+        self.rows = rows
+        self.offset_s = 0.0          # worker wall − fleet wall
+        self.uncertainty_s: Optional[float] = None
+
+
+def discover_worker_files(fleet_jsonl: str) -> List[str]:
+    """The fleet's workers write ``<fleet_jsonl>.worker<i>.jsonl``."""
+    return sorted(glob.glob(fleet_jsonl + ".worker*.jsonl"))
+
+
+def split_incarnations(rows: List[dict],
+                       fallback_replica: int = -1) -> List[_Segment]:
+    """Split an append-mode worker JSONL into per-incarnation segments.
+
+    Restarted workers APPEND to their file, so it holds one header per
+    incarnation; each header starts a new segment and carries the
+    incarnation's replica/incarnation/pid identity. Pre-header rows
+    (there should be none) attach to a synthetic segment so no row is
+    silently dropped.
+    """
+    segments: List[_Segment] = []
+    current: Optional[_Segment] = None
+    for row in rows:
+        if row.get("type") == "header":
+            rep = row.get("replica", fallback_replica)
+            inc = row.get("incarnation",
+                          len(segments))  # pre-v10 files: ordinal
+            current = _Segment(rep, inc, row.get("pid"), [row])
+            segments.append(current)
+            continue
+        if current is None:
+            current = _Segment(fallback_replica, 0, None, [])
+            segments.append(current)
+        current.rows.append(row)
+    return segments
+
+
+def clock_offsets(fleet_rows: List[dict]
+                  ) -> Dict[Tuple[int, int], Tuple[float, float]]:
+    """(replica, incarnation) -> (offset_s, uncertainty_s) from the
+    fleet's ``clock_sync`` events; the lowest-uncertainty sample wins.
+    """
+    best: Dict[Tuple[int, int], Tuple[float, float]] = {}
+    for row in fleet_rows:
+        if row.get("type") != "event" or row.get("event") != "clock_sync":
+            continue
+        rep, inc = row.get("replica"), row.get("incarnation", 0)
+        off, unc = _num(row, "offset_s"), _num(row, "uncertainty_s")
+        if rep is None or off is None:
+            continue
+        unc = unc if unc is not None else float("inf")
+        key = (rep, inc)
+        if key not in best or unc <= best[key][1]:
+            best[key] = (off, unc)
+    return best
+
+
+def _shift_row(row: dict, offset_s: float) -> dict:
+    """A worker row rebased onto the fleet clock (subtract the measured
+    worker−fleet offset from every wall-time field, children too)."""
+    if not offset_s:
+        return row
+    out = dict(row)
+    for key in ("time", "t0", "win_t0"):
+        v = out.get(key)
+        if isinstance(v, (int, float)):
+            out[key] = v - offset_s
+    if isinstance(out.get("children"), list):
+        kids = []
+        for c in out["children"]:
+            c = dict(c)
+            if isinstance(c.get("t0"), (int, float)):
+                c["t0"] = c["t0"] - offset_s
+            kids.append(c)
+        out["children"] = kids
+    return out
+
+
+def _segment_events(seg: _Segment, pid: int, base_s: float,
+                    named_tracks: set) -> Tuple[List[dict], int, int]:
+    """One incarnation's rows -> Chrome events on the worker's track.
+    Returns (events, n_spans, n_incidents)."""
+    events: List[dict] = []
+    n_spans = n_incidents = 0
+    t_prev: Optional[float] = None
+    for row in seg.rows:
+        kind = row.get("type")
+        if kind == "span":
+            t0, dur = _num(row, "t0"), _num(row, "dur_s")
+            if t0 is None or dur is None:
+                continue
+            rid = row.get("request_id")
+            tid = (rid + _TID_SPANS if isinstance(rid, int)
+                   else _TID_SPANS - 1)
+            if tid not in named_tracks:
+                named_tracks.add(tid)
+                events.append({"ph": "M", "pid": pid, "tid": tid,
+                               "name": "thread_name",
+                               "args": {"name": f"request {rid}"}})
+            args = {k: v for k, v in row.items()
+                    if k not in ("type", "time", "children", "t0",
+                                 "dur_s", "cat", "name")}
+            n_spans += 1
+            events.append(_x(str(row.get("name", "span")), pid, tid,
+                             (t0 - base_s) * 1e6, dur * 1e6,
+                             str(row.get("cat", "span")), args))
+            for c in row.get("children") or []:
+                ct0, cdur = _num(c, "t0"), _num(c, "dur_s")
+                if ct0 is None or cdur is None:
+                    continue
+                events.append(_x(str(c.get("name", "phase")), pid, tid,
+                                 (ct0 - base_s) * 1e6, cdur * 1e6,
+                                 "request_phase"))
+        elif kind == "metrics":
+            t = _num(row, "time")
+            if t is not None and _num(row, "tick_total_s"):
+                events += _window_events(row, pid, "ticks", TICK_PHASES,
+                                         "tick_", base_s, t_prev,
+                                         "ticks_in_window")
+                t_prev = t
+        elif kind == "event":
+            t = _num(row, "time")
+            name = row.get("event")
+            if t is not None and name in INCIDENT_EVENTS:
+                n_incidents += 1
+                events.append(_instant(
+                    str(name), pid, _TID_INCIDENTS, (t - base_s) * 1e6,
+                    "incident",
+                    {k: v for k, v in row.items()
+                     if k not in ("type", "time")}))
+    return events, n_spans, n_incidents
+
+
+def _flow_events(fleet_rows: List[dict], segments: List[_Segment],
+                 base_s: float) -> List[dict]:
+    """Chrome flow arrows: fleet request span -> the worker_request
+    span(s) that served it, joined on the FLEET request id."""
+    starts: Dict[int, float] = {}
+    for row in fleet_rows:
+        if (row.get("type") == "span" and row.get("name") == "request"
+                and isinstance(row.get("request_id"), int)):
+            t0 = _num(row, "t0")
+            if t0 is not None:
+                starts.setdefault(row["request_id"], t0)
+    events: List[dict] = []
+    for seg in segments:
+        pid = _PID_WORKER0 + seg.replica
+        for row in seg.rows:
+            if (row.get("type") != "span"
+                    or row.get("name") != "worker_request"
+                    or not isinstance(row.get("request_id"), int)):
+                continue
+            rid = row["request_id"]
+            t0 = _num(row, "t0")
+            if rid not in starts or t0 is None:
+                continue
+            # flow ids must be unique per arrow; requests can be served
+            # twice (redispatch), so fold the worker into the id
+            fid = rid * 64 + (seg.replica % 64)
+            events.append({"ph": "s", "id": fid, "pid": _PID_REQUESTS,
+                           "tid": rid, "name": "dispatch", "cat": "rpc",
+                           "ts": round((starts[rid] - base_s) * 1e6 + 1,
+                                       3)})
+            events.append({"ph": "f", "bp": "e", "id": fid, "pid": pid,
+                           "tid": rid + _TID_SPANS, "name": "dispatch",
+                           "cat": "rpc",
+                           "ts": round((t0 - base_s) * 1e6 + 1, 3)})
+    return events
+
+
+def fleet_chrome_trace(fleet_jsonl: str,
+                       worker_jsonls: Optional[List[str]] = None
+                       ) -> Dict[str, Any]:
+    """Merge the fleet JSONL + its workers' JSONLs into one Chrome
+    trace-event dict on the fleet's clock."""
+    fleet_rows = load_jsonl(fleet_jsonl)
+    paths = (worker_jsonls if worker_jsonls is not None
+             else discover_worker_files(fleet_jsonl))
+    offsets = clock_offsets(fleet_rows)
+    segments: List[_Segment] = []
+    for i, path in enumerate(paths):
+        for seg in split_incarnations(load_jsonl(path),
+                                      fallback_replica=i):
+            got = (offsets.get((seg.replica, seg.incarnation))
+                   # an incarnation that died before any clock_sync
+                   # reached the JSONL: reuse the replica's best sample
+                   # (same host — the skew is the host's, not the
+                   # process's)
+                   or min((v for (r, _), v in offsets.items()
+                           if r == seg.replica),
+                          key=lambda v: v[1], default=None))
+            if got is not None:
+                seg.offset_s, seg.uncertainty_s = got
+                seg.rows = [_shift_row(r, seg.offset_s)
+                            for r in seg.rows]
+            segments.append(seg)
+
+    times: List[float] = []
+    for rows in [fleet_rows] + [s.rows for s in segments]:
+        times += [r["time"] for r in rows
+                  if isinstance(r.get("time"), (int, float))]
+        times += [r["t0"] for r in rows if r.get("type") == "span"
+                  and isinstance(r.get("t0"), (int, float))]
+    base_s = min(times) if times else 0.0
+
+    trace = chrome_trace(fleet_rows, base_s=base_s)
+    events = trace["traceEvents"]
+    n_worker_spans = n_worker_incidents = 0
+    named: Dict[int, set] = {}
+    for seg in segments:
+        pid = _PID_WORKER0 + seg.replica
+        if seg.replica not in named:
+            named[seg.replica] = set()
+            events += _meta(pid, f"worker{seg.replica}", 1,
+                            "tick windows")
+            events += _meta(pid, f"worker{seg.replica}", 2,
+                            "tick phases")
+            events += _meta(pid, f"worker{seg.replica}",
+                            _TID_INCIDENTS, "incidents")
+        evs, n_s, n_i = _segment_events(seg, pid, base_s,
+                                        named[seg.replica])
+        events += evs
+        n_worker_spans += n_s
+        n_worker_incidents += n_i
+    flows = _flow_events(fleet_rows, segments, base_s)
+    events += flows
+
+    trace["metadata"].update({
+        "source": "building_llm_from_scratch_tpu obs/fleetview.py",
+        "n_worker_files": len(paths),
+        "n_incarnations": len(segments),
+        "n_worker_spans": n_worker_spans,
+        "n_worker_incidents": n_worker_incidents,
+        "n_flow_edges": len(flows) // 2,
+        "clock_offsets_s": {
+            f"worker{s.replica}.inc{s.incarnation}":
+                {"offset_s": round(s.offset_s, 6),
+                 "uncertainty_s": (round(s.uncertainty_s, 6)
+                                   if s.uncertainty_s is not None
+                                   else None)}
+            for s in segments},
+    })
+    return trace
+
+
+def export_fleet_trace(fleet_jsonl: str, out_path: str,
+                       worker_jsonls: Optional[List[str]] = None
+                       ) -> Dict[str, Any]:
+    """Render the merged fleet timeline at ``out_path``; returns the
+    trace's ``metadata`` summary."""
+    trace = fleet_chrome_trace(fleet_jsonl, worker_jsonls)
+    with open(out_path, "w") as f:
+        json.dump(trace, f, sort_keys=True)
+    return trace["metadata"]
+
+
+def main(argv=None) -> int:
+    import argparse
+    import os
+
+    p = argparse.ArgumentParser(
+        description="Merge a ProcessFleet's metrics JSONL + its "
+                    "<jsonl>.worker*.jsonl files into one skew-"
+                    "corrected Chrome trace (https://ui.perfetto.dev).")
+    p.add_argument("jsonl", help="the FLEET's metrics JSONL")
+    p.add_argument("-o", "--out", default=None,
+                   help="output path (default: <jsonl>.fleet_trace.json)")
+    p.add_argument("--worker", action="append", default=None,
+                   help="explicit worker JSONL (repeatable; default: "
+                        "discover <jsonl>.worker*.jsonl)")
+    args = p.parse_args(argv)
+    out = args.out or (os.path.splitext(args.jsonl)[0]
+                       + ".fleet_trace.json")
+    meta = export_fleet_trace(args.jsonl, out, args.worker)
+    print(f"wrote {out}: {meta['n_request_spans']} fleet request spans, "
+          f"{meta['n_worker_spans']} worker spans across "
+          f"{meta['n_incarnations']} incarnations "
+          f"({meta['n_worker_files']} worker files), "
+          f"{meta['n_flow_edges']} rpc edges, "
+          f"{meta['n_worker_incidents']} worker incidents")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -338,9 +338,14 @@ class MetricLogger:
     """
 
     def __init__(self, jsonl_path: Optional[str] = None,
-                 coordinator_only: bool = True):
+                 coordinator_only: bool = True, append: bool = False):
         self.jsonl_path = jsonl_path
         self.coordinator_only = coordinator_only
+        # append=True: a restarted process APPENDS to the existing file
+        # instead of rotating it aside — the fleet-worker convention,
+        # where one file accumulates one header per incarnation and the
+        # renderer splits on headers (summarize_metrics.split_incarnations)
+        self.append = append
         # REENTRANT: GracefulStopper's signal handler emits an event, and
         # the signal can land while THIS thread already holds the lock
         # inside a write — a plain Lock would self-deadlock. Reentry is
@@ -411,7 +416,11 @@ class MetricLogger:
                     # sequence. Rotate the previous run's file aside
                     # (.1, .2, ...) instead of truncating it — the killed
                     # run's telemetry is exactly what a postmortem needs.
-                    if os.path.exists(self.jsonl_path) and os.path.getsize(
+                    # append mode opts out: restarted fleet workers stack
+                    # incarnations (header-delimited) in ONE file, so the
+                    # victim's last rows and its successor's share a path.
+                    if not self.append and os.path.exists(
+                            self.jsonl_path) and os.path.getsize(
                             self.jsonl_path) > 0:
                         n = 1
                         while os.path.exists(f"{self.jsonl_path}.{n}"):
@@ -540,17 +549,19 @@ def _close_global_at_exit() -> None:
 
 
 def configure_metrics(jsonl_path: Optional[str],
-                      run_metadata: Optional[Dict[str, Any]] = None
-                      ) -> MetricLogger:
+                      run_metadata: Optional[Dict[str, Any]] = None,
+                      append: bool = False) -> MetricLogger:
     """Install the process-global MetricLogger (closing any previous one).
     With ``run_metadata`` the header is written immediately; without it,
     rows buffer until the caller's ``write_header`` (main.py configures
     before component build so fetch/retry events are captured, then writes
     the header once mesh + model metadata exist). ``jsonl_path=None``
-    resets to the no-op sink (tests use this to isolate)."""
+    resets to the no-op sink (tests use this to isolate). ``append=True``
+    appends to an existing file instead of rotating it (fleet workers:
+    one file per replica, one header per incarnation)."""
     global _global_logger, _atexit_registered
     _global_logger.close()
-    _global_logger = MetricLogger(jsonl_path)
+    _global_logger = MetricLogger(jsonl_path, append=append)
     if jsonl_path is not None and not _atexit_registered:
         # flush-at-exit makes the pre-header buffering promise real: if
         # the run dies before its header (e.g. build_components exhausts

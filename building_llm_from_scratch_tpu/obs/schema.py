@@ -35,10 +35,15 @@ from typing import Any, Dict, FrozenSet, List
 
 #: Bump when a row type or a load-bearing field changes meaning. The
 #: ``header`` row carries it; consumers key parsing decisions on it.
-SCHEMA_VERSION = 9          # v9: cross-process fleet — worker_spawn /
+SCHEMA_VERSION = 10         # v10: fleet observatory — clock_sync /
+                            # incident_snapshot events, worker_request +
+                            # rpc span roots (worker-side trees stamped
+                            # with pid/incarnation), worker_* events
+                            # rendered on the incidents trace track
+                            # (v9: cross-process fleet — worker_spawn /
                             # worker_heartbeat_missed / worker_dead /
                             # worker_restart / pane_handoff events
-                            # (serving/fleet.py supervision + prefix-
+                            # serving/fleet.py supervision + prefix-
                             # pane handoff over the RPC transport)
                             # (v8: scale-out serving — serve_fleet /
                             # replica_drain / replica_restart /
@@ -66,9 +71,14 @@ TRAIN_SEGMENTS = ("data_wait", "dispatch", "host_fetch", "eval", "sample",
                   "checkpoint")
 
 #: Event kinds rendered as instants on the trace's incidents track.
+#: The worker-process lifecycle kinds joined in v10 so the fleet
+#: exporter (obs/fleetview.py) and the single-file exporter render the
+#: same death/restart instants without a second table.
 INCIDENT_EVENTS = ("engine_restart", "drain", "serve_error", "stall",
                    "watchdog_halt", "preemption_signal", "preemption_stop",
-                   "checkpoint_fallback", "serve_warmup")
+                   "checkpoint_fallback", "serve_warmup",
+                   "worker_spawn", "worker_heartbeat_missed", "worker_dead",
+                   "worker_restart", "pane_handoff", "incident_snapshot")
 
 #: Request-lifecycle event kinds pinned to the request's own trace track.
 REQUEST_EVENTS = ("request_done", "request_rejected", "request_shed",
@@ -82,7 +92,13 @@ SERVING_LIFECYCLE_EVENTS = ("engine_restart", "drain", "serve_error",
                             "worker_spawn", "worker_dead", "worker_restart")
 
 #: Root span names the ``span`` row type may carry (one tree per row).
-SPAN_NAMES = ("request",)
+#: ``request`` is the router-side tree (one per request, emitted at the
+#: terminal outcome whatever it was — worker_dead included).
+#: ``worker_request`` is the worker-process-side view of the same
+#: request (same ``request_id``, stamped with pid/incarnation).
+#: ``rpc`` is one server-side RPC handle (method + request_id), so the
+#: merged timeline can show client wait vs server handle per hop.
+SPAN_NAMES = ("request", "worker_request", "rpc")
 
 #: Child span names under a ``request`` root, in lifecycle order.
 #: ``router`` (fleet dispatch hop, serving/router.py) only appears on
@@ -334,6 +350,20 @@ _EVENT_LIST: List[EventSpec] = [
           doc="a draining worker's hot PrefixStore panes shipped over "
               "the transport to an adopting replica (keys are config-"
               "fingerprinted, so they transfer verbatim)"),
+    _spec("clock_sync", required=("replica", "offset_s", "uncertainty_s"),
+          optional=("rtt_s", "incarnation", "pid", "source", "n_samples"),
+          doc="NTP-style worker-clock offset estimate from an RPC "
+              "round-trip midpoint: offset_s = worker wall clock minus "
+              "supervisor wall clock, bounded by uncertainty_s = rtt/2 "
+              "(source: ping|heartbeat). The fleet exporter uses the "
+              "min-uncertainty sample per incarnation to shift worker "
+              "rows onto the supervisor's timeline"),
+    _spec("incident_snapshot", required=("reason", "path"),
+          optional=("n_events", "replica"),
+          doc="the fleet's bounded in-memory event ring was snapshotted "
+              "to an incident file (worker death / restart-budget "
+              "exhaustion) — the file holds the last N fleet events "
+              "leading up to the incident"),
     _spec("drain", required=("phase",),
           optional=("timeout_s", "n_active", "queue_depth", "n_preempted",
                     "seconds", "requests_finished", "replica"),

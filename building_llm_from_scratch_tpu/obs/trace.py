@@ -168,14 +168,19 @@ def _window_events(row: dict, pid: int, label: str, phases,
     return out
 
 
-def chrome_trace(rows: List[dict]) -> Dict[str, Any]:
+def chrome_trace(rows: List[dict],
+                 base_s: Optional[float] = None) -> Dict[str, Any]:
     """Convert parsed metrics-JSONL rows to a Chrome trace-event dict
-    (``json.dump`` it to get a Perfetto-loadable file)."""
-    times = [r["time"] for r in rows
-             if isinstance(r.get("time"), (int, float))]
-    times += [r["t0"] for r in rows if r.get("type") == "span"
-              and isinstance(r.get("t0"), (int, float))]
-    base_s = min(times) if times else 0.0
+    (``json.dump`` it to get a Perfetto-loadable file). ``base_s`` pins
+    the epoch the timeline rebases onto — the fleet exporter passes the
+    minimum across ALL merged files so every process shares one clock;
+    single-file export derives it from this file's rows."""
+    if base_s is None:
+        times = [r["time"] for r in rows
+                 if isinstance(r.get("time"), (int, float))]
+        times += [r["t0"] for r in rows if r.get("type") == "span"
+                  and isinstance(r.get("t0"), (int, float))]
+        base_s = min(times) if times else 0.0
     events: List[dict] = []
     events += _meta(_PID_REQUESTS, "requests")
     events += _meta(_PID_ENGINE, "engine", 1, "tick windows")

@@ -31,6 +31,7 @@ The fleet object is engine-shaped: ``make_http_server``/``serve_jsonl``
 from __future__ import annotations
 
 import dataclasses
+import json
 import os
 import socket
 import subprocess
@@ -38,6 +39,7 @@ import sys
 import tempfile
 import threading
 import time
+from collections import deque
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -57,12 +59,15 @@ from building_llm_from_scratch_tpu.serving.queue import (
 )
 from building_llm_from_scratch_tpu.serving.request import (
     FINISHED,
+    FINISH_REJECTED,
+    FINISH_SHED,
     Request,
     SamplingParams,
     next_request_id,
 )
 from building_llm_from_scratch_tpu.serving.transport import (
     RpcClient,
+    RpcStats,
     TransportError,
     recv_frame,
     send_frame,
@@ -74,14 +79,33 @@ logger = setup_logger(__name__)
 
 _WORKER_MODULE = "building_llm_from_scratch_tpu.serving._worker_main"
 
+#: Per-worker budget for one aggregated-/metrics scrape RPC, and the
+#: whole-endpoint deadline: a dead or hung worker costs AT MOST this
+#: much wall time — the endpoint then serves its cached series instead.
+_SCRAPE_TIMEOUT_S = 0.4
+_SCRAPE_DEADLINE_S = 0.9
 
-def _labeled(key: str, replica: int) -> str:
-    """Merge ``replica="i"`` into a metric key's label set (same
-    convention as the in-process router's)."""
+#: Flight-recorder depth: the last N fleet incidents kept in memory for
+#: post-mortem snapshots (ring — old rows fall off, never grows).
+_INCIDENT_RING = 256
+
+#: Minimum seconds between ``clock_sync`` emissions per worker (every
+#: RPC refines the sample; only refreshes reach the JSONL).
+_CLOCK_SYNC_EVERY_S = 5.0
+
+
+def _labeled(key: str, replica: int, incarnation: int) -> str:
+    """Merge ``replica``/``worker``/``incarnation`` into a metric key's
+    label set. ``replica`` keeps the in-process router's convention;
+    ``worker``/``incarnation`` are the fleet-scrape passthrough labels
+    (a restarted worker's series are distinguishable from its previous
+    life's)."""
+    extra = (f'replica="{replica}",worker="{replica}",'
+             f'incarnation="{incarnation}"')
     base, sep, labels = key.partition("{")
     if not sep:
-        return f'{base}{{replica="{replica}"}}'
-    return f'{base}{{{labels[:-1]},replica="{replica}"}}'
+        return f"{base}{{{extra}}}"
+    return f"{base}{{{labels[:-1]},{extra}}}"
 
 
 class _HistSnap:
@@ -101,7 +125,8 @@ class _HistSnap:
 class _FleetEntry:
     """Ledger row: one in-flight request's cross-process identity."""
 
-    __slots__ = ("req", "prompt_ids", "params", "worker", "state")
+    __slots__ = ("req", "prompt_ids", "params", "worker", "state",
+                 "rpc_spans", "span_emitted", "incarnation")
 
     def __init__(self, req: Request, prompt_ids: List[int],
                  params: Dict[str, Any], worker: int):
@@ -110,6 +135,17 @@ class _FleetEntry:
         self.params = params
         self.worker = worker
         self.state = "queued"        # "queued" | "running"
+        self.rpc_spans: List[dict] = []   # closed rpc:<method> children
+        self.span_emitted = False    # exactly one trace tree, ever
+        self.incarnation = 0         # worker's life number at dispatch
+
+    def add_rpc(self, timing: dict) -> None:
+        """``RpcClient.call`` timing hook → one ``rpc:<method>`` child
+        on this request's span. The method rides in the NAME because
+        ``log_span`` keeps only name/t0/dur_s on children."""
+        self.rpc_spans.append({"name": "rpc:" + timing["method"],
+                               "t0": timing["t0"],
+                               "dur_s": timing["dur_s"]})
 
 
 class WorkerSupervisor:
@@ -123,7 +159,9 @@ class WorkerSupervisor:
     __slots__ = ("index", "socket_path", "metrics_path", "proc", "ctrl",
                  "events_sock", "pid", "alive", "stopped", "restarts",
                  "last_beat", "snapshot", "generation", "closing",
-                 "out_of_dispatch")
+                 "out_of_dispatch", "incarnation", "last_beat_wall",
+                 "clock", "last_clock_emit", "scrape", "last_metrics",
+                 "last_metrics_wall")
 
     def __init__(self, index: int, socket_path: str,
                  metrics_path: Optional[str]):
@@ -142,6 +180,13 @@ class WorkerSupervisor:
         self.generation = 0          # bumped per spawn; stale-event guard
         self.closing = False         # intentional teardown in progress
         self.out_of_dispatch = False
+        self.incarnation = 0         # == restarts at spawn time
+        self.last_beat_wall: Optional[float] = None  # worker's own stamp
+        self.clock = None            # freshest RPC-derived ClockSample
+        self.last_clock_emit = 0.0   # wall time of last clock_sync event
+        self.scrape: Optional[RpcClient] = None  # metrics-only conn
+        self.last_metrics: Optional[dict] = None  # cached /metrics reply
+        self.last_metrics_wall = 0.0
 
 
 class ProcessFleet:
@@ -178,6 +223,7 @@ class ProcessFleet:
         self.default_max_new_tokens = default_max_new_tokens
         self.warmed_up = False
         self._dir = socket_dir or tempfile.mkdtemp(prefix="fleet_")
+        self.metrics_base = metrics_base
         self._lock = threading.Lock()
         self._requests: Dict[int, _FleetEntry] = {}    # guarded-by: _lock
         self._draining = False
@@ -186,6 +232,10 @@ class ProcessFleet:
         self.n_restarts = 0                            # guarded-by: _lock
         self.n_redispatched = 0                        # guarded-by: _lock
         self.n_failed_on_death = 0                     # guarded-by: _lock
+        self.n_handoffs = 0                            # guarded-by: _lock
+        self.rpc_stats = RpcStats()  # shared across every fleet client
+        self._incidents: deque = deque(maxlen=_INCIDENT_RING)
+        self._incident_seq = 0                         # guarded-by: _lock
         self.workers = [
             WorkerSupervisor(
                 i, os.path.join(self._dir, f"w{i}.sock"),
@@ -243,6 +293,7 @@ class ProcessFleet:
                "--socket", w.socket_path,
                "--spec", self.spec.to_json(),
                "--replica", str(w.index),
+               "--incarnation", str(w.restarts),
                "--heartbeat_s", str(self.heartbeat_s),
                "--drain_timeout", str(self.drain_timeout_s)]
         if w.metrics_path:
@@ -270,7 +321,12 @@ class ProcessFleet:
             raise RuntimeError(
                 f"worker {w.index} not ready within "
                 f"{self.ready_timeout_s}s")
-        ctrl = RpcClient(w.socket_path, timeout=self.call_timeout_s)
+        ctrl = RpcClient(w.socket_path, timeout=self.call_timeout_s,
+                         stats=self.rpc_stats)
+        try:
+            ctrl.call("ping")        # first NTP-style clock sample
+        except (TransportError, RuntimeError):
+            pass
         ev_sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         ev_sock.connect(w.socket_path)
         send_frame(ev_sock, {"method": "subscribe", "args": {}})
@@ -287,6 +343,10 @@ class ProcessFleet:
             w.closing = False
             w.out_of_dispatch = False
             w.last_beat = time.monotonic()
+            w.incarnation = w.restarts
+            w.last_beat_wall = None
+            w.clock = None
+            w.last_clock_emit = 0.0
         threading.Thread(target=self._stdout_loop, args=(w, gen, proc),
                          name=f"fleet-stdout-{w.index}",
                          daemon=True).start()
@@ -296,8 +356,76 @@ class ProcessFleet:
         get_metrics().event("worker_spawn", replica=w.index, pid=w.pid,
                             restarts=w.restarts,
                             seconds=round(time.monotonic() - t0, 3))
+        self._incident("worker_spawn", replica=w.index, pid=w.pid,
+                       restarts=w.restarts)
+        self._note_clock(w)
         logger.info("Worker %d up (pid %d, %.2fs).", w.index, w.pid,
                     time.monotonic() - t0)
+
+    # -- observability -----------------------------------------------------
+
+    def _incident(self, kind: str, **fields) -> None:
+        """Flight recorder: bounded in-memory ring of incident rows,
+        snapshotted to a file when a worker dies or runs out of restart
+        budget (the telemetry JSONL has the same rows — the snapshot is
+        the grab-and-go artifact for a pager incident)."""
+        row = {"wall": time.time(), "kind": kind}
+        row.update(fields)
+        self._incidents.append(row)
+
+    def _snapshot_incidents(self, reason: str,
+                            replica: Optional[int] = None
+                            ) -> Optional[str]:
+        """Dump the incident ring to a JSON file and log where."""
+        with self._lock:
+            rows = list(self._incidents)
+            self._incident_seq += 1
+            seq = self._incident_seq
+        path = (f"{self.metrics_base}.incident{seq}.json"
+                if self.metrics_base
+                else os.path.join(self._dir, f"incident{seq}.json"))
+        try:
+            with open(path, "w") as f:
+                json.dump({"reason": reason, "wall": time.time(),
+                           "n_events": len(rows), "events": rows},
+                          f, sort_keys=True)
+        except OSError as e:
+            logger.warning("Incident snapshot failed: %s", e)
+            return None
+        get_metrics().event("incident_snapshot", reason=reason,
+                            path=path, n_events=len(rows),
+                            replica=replica)
+        logger.error("Incident snapshot (%s): %d events -> %s", reason,
+                     len(rows), path)
+        return path
+
+    def _note_clock(self, w: WorkerSupervisor) -> None:
+        """Publish worker ``w``'s freshest RPC-derived clock sample as a
+        ``clock_sync`` event. Every reply refines the estimate; only a
+        cadence tick or a big uncertainty improvement reaches the JSONL.
+        The merged-timeline exporter keys corrections on these rows."""
+        ctrl = w.ctrl
+        sample = ctrl.clock if ctrl is not None else None
+        if sample is None:
+            return
+        now = time.time()
+        with self._lock:
+            prev = w.clock
+            w.clock = sample
+            due = (prev is None
+                   or sample.uncertainty_s < prev.uncertainty_s * 0.5
+                   or now - w.last_clock_emit >= _CLOCK_SYNC_EVERY_S)
+            if not due:
+                return
+            w.last_clock_emit = now
+            incarnation = w.incarnation
+            pid = w.pid
+        get_metrics().event(
+            "clock_sync", replica=w.index,
+            offset_s=round(sample.offset_s, 6),
+            uncertainty_s=round(sample.uncertainty_s, 6),
+            rtt_s=round(sample.rtt_s, 6), incarnation=incarnation,
+            pid=pid, source="rpc_midpoint", n_samples=sample.n_samples)
 
     # -- liveness ----------------------------------------------------------
 
@@ -331,16 +459,28 @@ class ProcessFleet:
                     live = w.alive and not w.closing
                     gen = w.generation
                     age = now - w.last_beat
+                    beat_wall = w.last_beat_wall
+                    clock = w.clock
                 if not live:
                     continue
+                self._note_clock(w)
                 if w.proc is not None and w.proc.poll() is not None:
                     self._on_death(w, gen, f"exit_{w.proc.returncode}")
                     continue
+                if beat_wall is not None and clock is not None:
+                    # Paired-timestamp age: the worker stamps each beat
+                    # with ITS wall clock; skew-correcting that onto
+                    # ours measures send-to-now directly, immune to
+                    # event-thread receive jitter on the fleet side.
+                    age = time.time() - (beat_wall - clock.offset_s)
                 if age > self.heartbeat_timeout_s:
                     get_metrics().event(
                         "worker_heartbeat_missed", replica=w.index,
                         age_s=round(age, 3),
                         timeout_s=self.heartbeat_timeout_s, pid=w.pid)
+                    self._incident("worker_heartbeat_missed",
+                                   replica=w.index, age_s=round(age, 3),
+                                   pid=w.pid)
                     logger.error(
                         "Worker %d: no heartbeat for %.2fs (timeout "
                         "%.2fs) — killing it.", w.index, age,
@@ -360,6 +500,7 @@ class ProcessFleet:
             with self._lock:
                 if w.generation == gen:
                     w.last_beat = time.monotonic()
+                    w.last_beat_wall = ev.get("wall")
                     w.snapshot = ev.get("snapshot")
             return
         cid = ev.get("client_id")
@@ -404,6 +545,7 @@ class ProcessFleet:
                 req.t_first_token = time.monotonic()
             req.t_finish = time.monotonic()
             req._mark_done()
+            self._emit_request_span(entry)
             return
         if kind == "failed":
             with self._lock:
@@ -415,7 +557,30 @@ class ProcessFleet:
             req.state = FINISHED
             req.t_finish = time.monotonic()
             req._mark_done()
+            self._emit_request_span(entry)
             return
+
+    def _emit_request_span(self, entry: _FleetEntry) -> None:
+        """The fleet-side request span: exactly ONE closed tree per
+        request id, whatever the outcome — done, failed, shed,
+        rejected, expired, worker_dead, or shutdown leftover. The RPC
+        hops ride as extra ``rpc:<method>`` children; the worker's own
+        ``worker_request`` span joins on the same request_id in the
+        merged timeline."""
+        with self._lock:
+            if entry.span_emitted:
+                return
+            entry.span_emitted = True
+        try:
+            row = entry.req.trace_row()
+            rpc = sorted(entry.rpc_spans,
+                         key=lambda c: (c["t0"], c["name"]))
+            row["children"] = list(row.get("children") or ()) + rpc
+            row["worker"] = entry.worker
+            row["incarnation"] = entry.incarnation
+            get_metrics().log_span(**row)
+        except Exception:                # noqa: BLE001 - telemetry only
+            logger.exception("Fleet request span emit failed (ignored).")
 
     # -- death + restart ---------------------------------------------------
 
@@ -439,6 +604,9 @@ class ProcessFleet:
         pid = w.pid
         if w.ctrl is not None:
             w.ctrl.close()
+        if w.scrape is not None:
+            w.scrape.close()
+            w.scrape = None
         if w.events_sock is not None:
             try:
                 w.events_sock.close()
@@ -448,6 +616,9 @@ class ProcessFleet:
                             pid=pid, queued_redispatched=len(queued),
                             inflight_failed=len(running),
                             restarts=w.restarts)
+        self._incident("worker_dead", replica=w.index, reason=reason,
+                       pid=pid, queued_redispatched=len(queued),
+                       inflight_failed=len(running))
         logger.error(
             "Worker %d DIED (%s, pid %s): re-dispatching %d queued, "
             "failing %d in-flight.", w.index, reason, pid, len(queued),
@@ -460,12 +631,16 @@ class ProcessFleet:
             self._redispatch(e, from_replica=w.index)
         if self._closing or self._draining:
             return
+        self._snapshot_incidents(f"worker_dead_{reason}",
+                                 replica=w.index)
         if w.restarts >= self.max_restarts:
             with self._lock:
                 w.stopped = True
             logger.error(
                 "Worker %d: restart budget (%d) exhausted — fleet "
                 "degrades to survivors.", w.index, self.max_restarts)
+            self._snapshot_incidents("restart_budget_exhausted",
+                                     replica=w.index)
             return
         threading.Thread(target=self._restart, args=(w,),
                          name=f"fleet-restart-{w.index}",
@@ -480,6 +655,8 @@ class ProcessFleet:
                 logger.error(
                     "Worker %d: restart budget (%d) exhausted — fleet "
                     "degrades to survivors.", w.index, self.max_restarts)
+                self._snapshot_incidents("restart_budget_exhausted",
+                                         replica=w.index)
                 return
             backoff = self.restart_backoff_s * (2.0 ** w.restarts)
             w.restarts += 1
@@ -498,6 +675,10 @@ class ProcessFleet:
                 "worker_restart", replica=w.index, restarts=w.restarts,
                 backoff_s=round(backoff, 3),
                 downtime_s=round(time.monotonic() - t_dead, 3), pid=w.pid)
+            self._incident(
+                "worker_restart", replica=w.index, restarts=w.restarts,
+                downtime_s=round(time.monotonic() - t_dead, 3),
+                pid=w.pid)
             logger.warning("Worker %d restarted (attempt %d, %.2fs down) "
                            "— back in dispatch.", w.index, w.restarts,
                            time.monotonic() - t_dead)
@@ -514,6 +695,7 @@ class ProcessFleet:
         req.state = FINISHED
         req.t_finish = time.monotonic()
         req._mark_done()
+        self._emit_request_span(e)
 
     def _redispatch(self, e: _FleetEntry, from_replica: int) -> None:
         """Move one queued request to a survivor under its ORIGINAL
@@ -528,11 +710,15 @@ class ProcessFleet:
             e.state = "queued"
             with self._lock:
                 self._requests[req.id] = e
+                e.incarnation = w.incarnation
             try:
                 w.ctrl.call("adopt", client_id=req.id,
                             prompt_ids=e.prompt_ids, params=e.params,
                             route={"replica": w.index,
-                                   "redispatched_from": from_replica})
+                                   "redispatched_from": from_replica},
+                            trace_ctx={"request_id": req.id,
+                                       "replica": w.index},
+                            on_timing=e.add_rpc)
             except (QueueFullError, SLOShedError, EngineDrainingError,
                     TransportError, RuntimeError) as err:
                 with self._lock:
@@ -549,6 +735,9 @@ class ProcessFleet:
             get_metrics().event("router_redispatch", request_id=req.id,
                                 from_replica=from_replica,
                                 to_replica=w.index)
+            self._incident("router_redispatch", request_id=req.id,
+                           from_replica=from_replica,
+                           to_replica=w.index)
             return
         self._fail_entry(e, "worker_dead",
                          f"worker_dead: worker {from_replica} died and "
@@ -598,19 +787,27 @@ class ProcessFleet:
                        if v is not None}
         wire_ids = [int(t) for t in prompt_ids]  # graft-ok: GL011 host numpy, no device
         req = Request(next_request_id(), prompt_ids, params, on_token)
+        # ONE ledger row reused across dispatch attempts, so the rpc
+        # child spans of refused hops still land on the final trace.
+        entry = _FleetEntry(req, wire_ids, wire_params, -1)
         deadline = (time.monotonic() + timeout
                     if (block and timeout is not None) else None)
         while True:
             first_refusal: Optional[BaseException] = None
             order = self._dispatch_order(params.max_new_tokens)
             for w in order:
-                entry = _FleetEntry(req, wire_ids, wire_params, w.index)
+                entry.worker = w.index
+                entry.state = "queued"
                 with self._lock:
                     self._requests[req.id] = entry
+                    entry.incarnation = w.incarnation
                 try:
                     w.ctrl.call("submit", client_id=req.id,
                                 prompt_ids=wire_ids, params=wire_params,
-                                route={"replica": w.index})
+                                route={"replica": w.index},
+                                trace_ctx={"request_id": req.id,
+                                           "replica": w.index},
+                                on_timing=entry.add_rpc)
                 except (QueueFullError, SLOShedError) as e:
                     claimed = self._unclaim(req, entry)
                     if not claimed:
@@ -629,12 +826,38 @@ class ProcessFleet:
                 first_refusal = first_refusal or RuntimeError(
                     "no live workers")
             if not block:
-                raise first_refusal or QueueFullError(
+                err = first_refusal or QueueFullError(
                     "every live worker refused admission")
+                self._finish_refused(req, entry, err)
+                raise err
             if deadline is not None and time.monotonic() >= deadline:
-                raise first_refusal or QueueFullError(
+                err = first_refusal or QueueFullError(
                     f"no worker admitted the request within {timeout}s")
+                self._finish_refused(req, entry, err)
+                raise err
             time.sleep(0.05)
+
+    def _finish_refused(self, req: Request, entry: _FleetEntry,
+                        err: BaseException) -> None:
+        """Close the telemetry for a request no worker admitted: the
+        raise is the client's answer; the refusal event + the closed
+        span tree are the timeline's."""
+        if isinstance(err, SLOShedError):
+            req.finish_reason = FINISH_SHED
+            get_metrics().event(
+                "request_shed", request_id=req.id, reason=str(err),
+                retry_after_s=getattr(err, "retry_after_s", None))
+        elif isinstance(err, QueueFullError):
+            req.finish_reason = FINISH_REJECTED
+            get_metrics().event("request_rejected", request_id=req.id,
+                                reason=str(err))
+        else:
+            req.finish_reason = "error"
+            req.error = str(err)
+        req.state = FINISHED
+        req.t_finish = time.monotonic()
+        req._mark_done()
+        self._emit_request_span(entry)
 
     def _unclaim(self, req: Request, entry: _FleetEntry) -> bool:
         """Remove a not-yet-acked ledger entry; False when the death
@@ -729,11 +952,15 @@ class ProcessFleet:
             logger.warning("Pane import into worker %d failed: %s",
                            adoptee.index, e)
             return
+        with self._lock:
+            self.n_handoffs += 1
         get_metrics().event(
             "pane_handoff", from_replica=w.index, to_replica=adoptee.index,
             entries=len(entries), imported=res.get("imported", 0),
             bytes=res.get("bytes", 0),
             seconds=round(time.monotonic() - t0, 3))
+        self._incident("pane_handoff", from_replica=w.index,
+                       to_replica=adoptee.index, entries=len(entries))
         logger.info("Prefix panes handed off %d -> %d: %d entries, %d "
                     "bytes, %.3fs.", w.index, adoptee.index,
                     len(entries), res.get("bytes", 0),
@@ -757,6 +984,9 @@ class ProcessFleet:
                 pass
         if w.ctrl is not None:
             w.ctrl.close()
+        if w.scrape is not None:
+            w.scrape.close()
+            w.scrape = None
         if w.events_sock is not None:
             try:
                 w.events_sock.close()
@@ -803,7 +1033,10 @@ class ProcessFleet:
             if not e.req.done:
                 e.req.finish_reason = "preempted"
                 e.req.error = "fleet shutdown"
+                e.req.state = FINISHED
+                e.req.t_finish = time.monotonic()
                 e.req._mark_done()
+            self._emit_request_span(e)
 
     def run_until_idle(self) -> None:
         while True:
@@ -879,30 +1112,93 @@ class ProcessFleet:
         out["workers"] = {i: per[i] for i in sorted(per)}
         return out
 
+    def _scrape_worker(self, w: WorkerSupervisor) -> None:
+        """Scrape one worker's metrics over a DEDICATED short-timeout
+        connection. A timeout desyncs the framed stream and poisons the
+        client — poisoning the CONTROL client would fail real dispatch,
+        so scrapes get their own connection and simply rebuild it."""
+        with self._lock:
+            cli = w.scrape
+            w.scrape = None          # taken: no concurrent scrape share
+        m = None
+        try:
+            if cli is None:
+                cli = RpcClient(w.socket_path,
+                                timeout=_SCRAPE_TIMEOUT_S,
+                                stats=self.rpc_stats)
+            m = cli.call("metrics", rpc_timeout=_SCRAPE_TIMEOUT_S)
+        except (TransportError, RuntimeError, OSError):
+            if cli is not None:
+                cli.close()
+            cli = None
+        with self._lock:
+            if cli is not None and w.scrape is None:
+                w.scrape = cli
+            if m is not None:
+                w.last_metrics = m
+                w.last_metrics_wall = time.time()
+
     def metrics_snapshot(self) -> tuple:
+        """Aggregated fleet metrics: live workers are scraped in
+        parallel over timed RPC; a dead or slow worker contributes its
+        last-known (cached) series plus a staleness gauge instead of
+        blocking the endpoint — same never-block discipline as
+        ``healthz_payload``."""
         counters: Dict[str, float] = {}
         gauges: Dict[str, float] = {}
         hists: Dict[str, Any] = {}
-        for w in self._live():
-            try:
-                m = w.ctrl.call("metrics")
-            except (TransportError, RuntimeError):
-                continue
+        live = self._live()
+        threads = [threading.Thread(target=self._scrape_worker,
+                                    args=(w,),
+                                    name=f"fleet-scrape-{w.index}",
+                                    daemon=True)
+                   for w in live]
+        scrape_deadline = time.monotonic() + _SCRAPE_DEADLINE_S
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(max(0.0, scrape_deadline - time.monotonic()))
+        now = time.time()
+        for w in self.workers:
+            with self._lock:
+                m = w.last_metrics
+                m_wall = w.last_metrics_wall
+                inc = w.incarnation
+            if m is None:
+                continue             # never scraped: nothing to serve
+            age = max(now - m_wall, 0.0)
+            stale = age > max(2.0 * self.heartbeat_s, _SCRAPE_DEADLINE_S)
+            lab = f'worker="{w.index}",incarnation="{inc}"'
+            gauges[f"fleet_worker_metrics_stale{{{lab}}}"] = (
+                1.0 if stale else 0.0)
+            gauges[f"fleet_worker_metrics_age_s{{{lab}}}"] = round(age, 3)
             for k, v in m.get("counters", {}).items():
-                counters[_labeled(k, w.index)] = v
+                counters[_labeled(k, w.index, inc)] = v
             for k, v in m.get("gauges", {}).items():
-                gauges[_labeled(k, w.index)] = v
+                gauges[_labeled(k, w.index, inc)] = v
             for k, v in m.get("hists", {}).items():
-                hists[_labeled(k, w.index)] = _HistSnap(v)
+                hists[_labeled(k, w.index, inc)] = _HistSnap(v)
+        # The fleet's own rpc-client instrumentation (per-method).
+        for method, s in self.rpc_stats.snapshot().items():
+            lab = f'{{method="{method}"}}'
+            counters[f"fleet_rpc_client_calls{lab}"] = s["calls"]
+            counters[f"fleet_rpc_client_errors{lab}"] = s["errors"]
+            counters[f"fleet_rpc_client_frame_bytes_sent{lab}"] = (
+                s["bytes_sent"])
+            counters[f"fleet_rpc_client_frame_bytes_received{lab}"] = (
+                s["bytes_received"])
+            hists[f"fleet_rpc_client_latency_seconds{lab}"] = _HistSnap(
+                s["latency"])
         with self._lock:
             up = sum(1 for w in self.workers if w.alive)
-            gauges["fleet_workers_up"] = float(up)
-            gauges["fleet_workers_total"] = float(self.n_workers)
-            counters["fleet_worker_deaths_total"] = float(self.n_deaths)
-            counters["fleet_worker_restarts_total"] = float(
-                self.n_restarts)
-            counters["fleet_redispatched_total"] = float(
-                self.n_redispatched)
+            gauges["fleet_workers_up"] = up
+            gauges["fleet_workers_total"] = self.n_workers
+            counters["fleet_worker_deaths_total"] = self.n_deaths
+            counters["fleet_worker_restarts_total"] = self.n_restarts
+            counters["fleet_redispatched_total"] = self.n_redispatched
+            counters["fleet_failed_on_death_total"] = (
+                self.n_failed_on_death)
+            counters["fleet_pane_handoffs_total"] = self.n_handoffs
         return counters, gauges, hists
 
     def prometheus_text(self) -> str:

@@ -318,6 +318,17 @@ def next_request_id() -> int:
         return _next_id[0]
 
 
+def seed_request_ids(start: int) -> None:
+    """Move the id counter to ``start`` (next id = start + 1). Fleet
+    worker processes seed a disjoint per-(replica, incarnation) range so
+    their LOCAL request ids can never collide with the supervisor's
+    fleet-wide ids in merged telemetry — a trace join on ``request_id``
+    must mean one request, whichever process stamped the row. Only moves
+    forward: a late seed never re-issues ids already handed out."""
+    with _COUNTER:
+        _next_id[0] = max(_next_id[0], int(start))
+
+
 __all__: List[Any] = [
     "QUEUED", "RUNNING", "FINISHED", "REJECTED",
     "FINISH_EOS", "FINISH_LENGTH", "FINISH_ERROR",
@@ -325,4 +336,5 @@ __all__: List[Any] = [
     "FINISH_SHED", "FINISH_REJECTED",
     "RequestExpiredError",
     "SamplingParams", "Request", "resolve_eos", "next_request_id",
+    "seed_request_ids",
 ]

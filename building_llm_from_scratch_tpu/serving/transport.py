@@ -25,6 +25,19 @@ responses and re-raise CLIENT-side as the same typed exceptions the
 in-process engine raises (``QueueFullError``, ``SLOShedError``,
 ``EngineDrainingError``, ...) so the frontends' status-code mapping
 works unchanged whether the engine is a thread away or a process away.
+
+Observability (PR 17): the transport sits on every fleet request's
+critical path, so it carries its own telemetry. Request frames may carry
+a ``trace`` object (request_id + parent-span context) that the server
+injects into handler args as ``args["_trace"]``; a request that carries
+the client's wall clock as ``ts`` gets its reply stamped with the
+server's paired ``{"wall", "mono"}`` clocks, which gives the client a
+free NTP-style offset sample per call (offset = server wall minus the
+round-trip midpoint, uncertainty = rtt/2 — the ``clock_sync`` event's
+math). ``RpcClient`` always sends ``ts``; hand-rolled raw-frame peers
+that omit it get byte-identical pre-PR-17 replies. ``RpcStats`` aggregates per-method latency histograms and
+frame-byte counters on both ends; all of it is host-side arithmetic on
+already-host floats — zero device syncs (GL01x-registered).
 """
 
 from __future__ import annotations
@@ -33,7 +46,10 @@ import json
 import socket
 import struct
 import threading
+import time
 from typing import Any, Callable, Dict, Optional, Tuple
+
+from building_llm_from_scratch_tpu.obs.metrics import Histogram
 
 from building_llm_from_scratch_tpu.serving.queue import (
     EngineDrainingError,
@@ -133,7 +149,9 @@ def _read_exact(sock: socket.socket, n: int) -> bytes:
 
 
 def send_frame(sock: socket.socket, obj: dict,
-               max_frame_bytes: int = MAX_FRAME_BYTES) -> None:
+               max_frame_bytes: int = MAX_FRAME_BYTES) -> int:
+    """Send one frame; returns the payload byte count (for the
+    frame-bytes counters — header bytes excluded, they're constant)."""
     payload = json.dumps(obj).encode("utf-8")
     if len(payload) > max_frame_bytes:
         raise FrameTooLargeError(
@@ -146,10 +164,13 @@ def send_frame(sock: socket.socket, obj: dict,
             f"send blocked past {sock.gettimeout()}s (peer slow)")
     except OSError as e:
         raise PeerGoneError(f"peer connection lost on send: {e}")
+    return len(payload)
 
 
-def recv_frame(sock: socket.socket,
-               max_frame_bytes: int = MAX_FRAME_BYTES) -> dict:
+def recv_frame_sized(sock: socket.socket,
+                     max_frame_bytes: int = MAX_FRAME_BYTES
+                     ) -> Tuple[dict, int]:
+    """``recv_frame`` plus the payload byte count."""
     (length,) = _HDR.unpack(_read_exact(sock, _HDR.size))
     if length > max_frame_bytes:
         # reject on the header — the payload is never read, so a
@@ -164,7 +185,85 @@ def recv_frame(sock: socket.socket,
     if not isinstance(obj, dict):
         raise FrameCorruptError(
             f"frame decodes to {type(obj).__name__}, expected object")
-    return obj
+    return obj, length
+
+
+def recv_frame(sock: socket.socket,
+               max_frame_bytes: int = MAX_FRAME_BYTES) -> dict:
+    return recv_frame_sized(sock, max_frame_bytes)[0]
+
+
+class RpcStats:
+    """Thread-safe per-method RPC telemetry: latency histograms plus
+    call/error and frame-byte counters. One instance is shared across
+    every ``RpcClient`` the fleet owns (so /metrics shows ONE
+    ``rpc_client_seconds{method=..}`` family), and one per
+    ``RpcServer``. Pure host arithmetic — safe on the request path.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._methods: Dict[str, Dict[str, Any]] = {}
+
+    def _entry(self, method: str) -> Dict[str, Any]:
+        e = self._methods.get(method)
+        if e is None:
+            e = {"calls": 0, "errors": 0, "bytes_sent": 0,
+                 "bytes_received": 0, "latency": Histogram()}
+            self._methods[method] = e
+        return e
+
+    def record(self, method: str, seconds: float, *, sent: int = 0,
+               received: int = 0, error: bool = False) -> None:
+        with self._lock:
+            e = self._entry(method)
+            e["calls"] += 1
+            if error:
+                e["errors"] += 1
+            e["bytes_sent"] += sent
+            e["bytes_received"] += received
+        e["latency"].observe(seconds)          # Histogram has its own lock
+
+    def add_bytes(self, method: str, *, sent: int = 0,
+                  received: int = 0) -> None:
+        """Bytes-only bump (no call counted) — for the reply frame the
+        server sends after ``record`` already counted the handle."""
+        with self._lock:
+            e = self._entry(method)
+            e["bytes_sent"] += sent
+            e["bytes_received"] += received
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """method -> {calls, errors, bytes_sent, bytes_received,
+        latency: histogram snapshot dict}."""
+        with self._lock:
+            methods = {m: dict(e) for m, e in self._methods.items()}
+        return {m: {"calls": e["calls"], "errors": e["errors"],
+                    "bytes_sent": e["bytes_sent"],
+                    "bytes_received": e["bytes_received"],
+                    "latency": e["latency"].snapshot()}
+                for m, e in methods.items()}
+
+
+class ClockSample:
+    """One NTP-style offset estimate of the peer's wall clock.
+
+    ``offset_s`` = peer wall − our wall (subtract it from a peer
+    timestamp to land on our timeline); true offset lies within
+    ``offset_s ± uncertainty_s`` where uncertainty = rtt/2 (the reply
+    could have been stamped anywhere inside the round trip).
+    """
+
+    __slots__ = ("offset_s", "uncertainty_s", "rtt_s", "wall",
+                 "n_samples")
+
+    def __init__(self, offset_s: float, uncertainty_s: float,
+                 rtt_s: float, wall: float, n_samples: int = 1):
+        self.offset_s = offset_s
+        self.uncertainty_s = uncertainty_s
+        self.rtt_s = rtt_s
+        self.wall = wall                       # when WE took the sample
+        self.n_samples = n_samples
 
 
 class RpcClient:
@@ -175,13 +274,22 @@ class RpcClient:
     Per-call timeouts via ``settimeout``; a timeout raises
     ``PeerTimeoutError`` and poisons the connection (the late response
     would desynchronize correlation), so the client closes it.
+
+    ``stats`` (a shared ``RpcStats``) collects per-method latency and
+    frame bytes; ``self.clock`` holds the minimum-uncertainty
+    ``ClockSample`` of the peer's wall clock seen so far (every reply
+    carries the server's paired timestamps, so each call is a free
+    offset sample — tightest rtt wins).
     """
 
     def __init__(self, path: str, *, timeout: float = 10.0,
-                 max_frame_bytes: int = MAX_FRAME_BYTES):
+                 max_frame_bytes: int = MAX_FRAME_BYTES,
+                 stats: Optional[RpcStats] = None):
         self.path = path
         self.timeout = timeout
         self.max_frame_bytes = max_frame_bytes
+        self.stats = stats
+        self.clock: Optional[ClockSample] = None
         self._lock = threading.Lock()
         self._sock: Optional[socket.socket] = None     # guarded-by: _lock
         sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
@@ -197,10 +305,18 @@ class RpcClient:
         self._sock = sock
 
     def call(self, method: str, rpc_timeout: Optional[float] = None,
+             trace_ctx: Optional[dict] = None,
+             on_timing: Optional[Callable[[dict], None]] = None,
              **args: Any) -> Any:
         """Invoke ``method`` on the peer; returns its result object.
         ``rpc_timeout`` overrides the client deadline for this one call
         (named to never collide with application kwargs like ``timeout``).
+        ``trace_ctx`` rides the frame as its ``trace`` object (the server
+        injects it into handler args as ``_trace``); ``on_timing``
+        receives this call's client-side timing dict
+        (t0/send_s/wait_s/dur_s/bytes) after the reply, outside the lock
+        — the hook that turns one call into an ``rpc:<method>`` child
+        span on the caller's request tree.
 
         Application errors re-raise typed (see ``raise_typed``);
         transport failures raise ``TransportError`` subclasses and close
@@ -208,6 +324,15 @@ class RpcClient:
         framing fault).
         """
         poisoned = None
+        t0_wall = time.time()
+        t0 = time.monotonic()
+        # ``ts`` opts the reply into the server's clock stamp — raw-frame
+        # peers that omit it see the stamp-free wire format.
+        frame: Dict[str, Any] = {"method": method, "args": args,
+                                 "ts": t0_wall}
+        if trace_ctx is not None:
+            frame["trace"] = trace_ctx
+        n_sent = n_recv = 0
         try:
             with self._lock:
                 sock = self._sock
@@ -216,12 +341,17 @@ class RpcClient:
                 sock.settimeout(self.timeout if rpc_timeout is None
                                 else rpc_timeout)
                 try:
-                    send_frame(sock, {"method": method, "args": args},
-                               self.max_frame_bytes)
-                    resp = recv_frame(sock, self.max_frame_bytes)
+                    n_sent = send_frame(sock, frame, self.max_frame_bytes)
+                    t_sent = time.monotonic()
+                    resp, n_recv = recv_frame_sized(sock,
+                                                    self.max_frame_bytes)
                 except TransportError:
                     self._sock = None        # detach under the lock ...
                     poisoned = sock
+                    if self.stats is not None:
+                        self.stats.record(method, time.monotonic() - t0,
+                                          sent=n_sent, received=n_recv,
+                                          error=True)
                     raise
         finally:
             if poisoned is not None:         # ... close outside it
@@ -229,6 +359,34 @@ class RpcClient:
                     poisoned.close()
                 except OSError:
                     pass
+        t1 = time.monotonic()
+        t1_wall = time.time()
+        if self.stats is not None:
+            self.stats.record(method, t1 - t0, sent=n_sent,
+                              received=n_recv, error="err" in resp)
+        srv = resp.get("srv")
+        if isinstance(srv, dict) and isinstance(srv.get("wall"),
+                                                (int, float)):
+            # NTP midpoint: the server stamped its reply somewhere inside
+            # [t0_wall, t1_wall]; assuming the midpoint bounds the error
+            # by rtt/2. Keep the tightest sample — short round trips are
+            # the most honest clocks.
+            rtt = t1 - t0
+            sample = ClockSample(
+                offset_s=srv["wall"] - (t0_wall + t1_wall) / 2.0,
+                uncertainty_s=rtt / 2.0, rtt_s=rtt, wall=t1_wall,
+                n_samples=1 if self.clock is None
+                else self.clock.n_samples + 1)
+            if (self.clock is None
+                    or sample.uncertainty_s <= self.clock.uncertainty_s):
+                self.clock = sample
+            else:
+                self.clock.n_samples = sample.n_samples
+        if on_timing is not None:
+            on_timing({"method": method, "t0": t0_wall,
+                       "send_s": t_sent - t0, "wait_s": t1 - t_sent,
+                       "dur_s": t1 - t0, "bytes_sent": n_sent,
+                       "bytes_received": n_recv})
         if "err" in resp:
             raise_typed(resp["err"])
         return resp.get("result")
@@ -260,13 +418,28 @@ class RpcServer:
     NEVER dies on a bad request; framing faults (oversized/garbage)
     get a best-effort error frame and the connection is closed, because
     the stream offset is gone.
+
+    A frame carrying a ``trace`` object has it injected into handler
+    args as ``args["_trace"]`` (handlers that don't know about tracing
+    must tolerate — or pop — the key); ``span_hook(method, trace,
+    t0_wall, dur_s, ok)`` then fires after the handler for each traced
+    frame (the worker logs these as ``rpc`` server-handle spans). Every
+    reply is stamped with ``srv: {wall, mono}`` so clients can estimate
+    this process's clock offset. ``stats`` aggregates per-method handle
+    latency and frame bytes.
     """
 
     def __init__(self, path: str, handler: Handler, *,
-                 max_frame_bytes: int = MAX_FRAME_BYTES):
+                 max_frame_bytes: int = MAX_FRAME_BYTES,
+                 stats: Optional[RpcStats] = None,
+                 span_hook: Optional[
+                     Callable[[str, dict, float, float, bool],
+                              None]] = None):
         self.path = path
         self.handler = handler
         self.max_frame_bytes = max_frame_bytes
+        self.stats = stats
+        self.span_hook = span_hook
         self._listener: Optional[socket.socket] = None
         self._stop = threading.Event()
         self._lock = threading.Lock()
@@ -296,16 +469,45 @@ class RpcServer:
             t.start()
             self._threads.append(t)
 
+    @staticmethod
+    def _srv_stamp() -> dict:
+        """Paired server clocks stamped on replies to ``ts``-carrying
+        requests (the client's offset-sample input)."""
+        return {"wall": time.time(), "mono": time.monotonic()}
+
+    def _reply(self, body: dict, stamped: bool) -> dict:
+        """Attach the server clock stamp iff the request opted in via
+        ``ts`` — raw-frame peers keep the unstamped wire format."""
+        if stamped:
+            body["srv"] = self._srv_stamp()
+        return body
+
+    def _finish(self, method: str, trace: Any, t0_wall: float,
+                dur_s: float, n_recv: int, *, ok: bool) -> None:
+        """Post-handler bookkeeping: per-method handle stats + the
+        traced-frame span hook. Hook failures are swallowed — telemetry
+        must never kill the serving loop."""
+        if self.stats is not None:
+            self.stats.record(method, dur_s, received=n_recv,
+                              error=not ok)
+        if self.span_hook is not None and isinstance(trace, dict):
+            try:
+                self.span_hook(method, trace, t0_wall, dur_s, ok)
+            except Exception:
+                logger.exception("rpc span hook failed (ignored)")
+
     def _serve_conn(self, conn: socket.socket) -> None:
         detached = False
         try:
             while not self._stop.is_set():
                 try:
-                    frame = recv_frame(conn, self.max_frame_bytes)
+                    frame, n_recv = recv_frame_sized(conn,
+                                                     self.max_frame_bytes)
                 except (PeerGoneError, PeerTimeoutError):
                     return
                 except (FrameTooLargeError, FrameCorruptError) as e:
                     # stream offset unrecoverable: answer typed, close
+                    # (no frame, so no stamp opt-in to honour)
                     try:
                         send_frame(conn, {"err": {
                             "type": "runtime",
@@ -313,40 +515,57 @@ class RpcServer:
                     except TransportError:
                         pass
                     return
+                stamped = isinstance(frame.get("ts"), (int, float))
                 method = frame.get("method")
                 args = frame.get("args") or {}
                 if not isinstance(method, str) or not isinstance(args, dict):
                     try:
-                        send_frame(conn, {"err": {
+                        send_frame(conn, self._reply({"err": {
                             "type": "value_error",
-                            "message": "malformed request frame"}})
+                            "message": "malformed request frame"}},
+                            stamped))
                         continue
                     except TransportError:
                         return
+                trace = frame.get("trace")
+                if isinstance(trace, dict):
+                    args = dict(args)
+                    args["_trace"] = trace
+                t0_wall = time.time()
+                t0 = time.monotonic()
                 try:
                     result = self.handler(method, args, conn)
                 except TransportError:
                     return
                 except BaseException as e:             # typed error reply
+                    self._finish(method, trace, t0_wall,
+                                 time.monotonic() - t0, n_recv, ok=False)
                     try:
-                        send_frame(conn, {"err": error_payload(e)})
+                        send_frame(conn, self._reply(
+                            {"err": error_payload(e)}, stamped))
                         continue
                     except TransportError:
                         return
+                self._finish(method, trace, t0_wall,
+                             time.monotonic() - t0, n_recv, ok=True)
                 if isinstance(result, tuple) and len(result) == 2 \
                         and result[0] is DETACH:
                     try:
-                        send_frame(conn, {"result": result[1]},
-                                   self.max_frame_bytes)
+                        send_frame(conn, self._reply(
+                            {"result": result[1]}, stamped),
+                            self.max_frame_bytes)
                     except TransportError:
                         return
                     detached = True
                     return                             # handler owns sock
                 try:
-                    send_frame(conn, {"result": result},
-                               self.max_frame_bytes)
+                    n_sent = send_frame(conn, self._reply(
+                        {"result": result}, stamped),
+                        self.max_frame_bytes)
                 except TransportError:
                     return
+                if self.stats is not None:
+                    self.stats.add_bytes(method, sent=n_sent)
         finally:
             if not detached:
                 with self._lock:

@@ -57,9 +57,11 @@ from building_llm_from_scratch_tpu.serving.kvcache import (
 from building_llm_from_scratch_tpu.serving.queue import (
     EngineDrainingError,
     RequestQueue,
+    SLOShedError,
 )
 from building_llm_from_scratch_tpu.serving.request import (
     FINISH_CANCELLED,
+    FINISH_EXPIRED,
     FINISH_LENGTH,
     FINISH_PREEMPTED,
     FINISHED,
@@ -67,10 +69,12 @@ from building_llm_from_scratch_tpu.serving.request import (
     Request,
     SamplingParams,
     next_request_id,
+    seed_request_ids,
 )
 from building_llm_from_scratch_tpu.serving.transport import (
     DETACH,
     RpcServer,
+    RpcStats,
     TransportError,
     send_frame,
 )
@@ -318,6 +322,16 @@ class FakeEngine:
             max_new_tokens=self.default_max_new_tokens)
         if params.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if params.deadline_s is not None:
+            # deadline-aware admission, FakeEngine style: the decode cost
+            # is exactly max_new_tokens ticks of tpot_s, so a deadline
+            # below that is a predicted miss — shed now (mirrors
+            # DecodeEngine's TPOT-EWMA estimate, deterministic here)
+            est = params.max_new_tokens * self.tpot_s
+            if params.deadline_s < est:
+                raise SLOShedError(
+                    f"deadline {params.deadline_s:.3f}s < estimated "
+                    f"decode {est:.3f}s", retry_after_s=est)
         prompt_ids = np.asarray(prompt, np.int32).reshape(-1)
         req = Request(next_request_id(), prompt_ids, params, on_token)
         req.route = route
@@ -346,13 +360,22 @@ class FakeEngine:
 
     def _loop(self) -> None:
         while not self._stop.is_set():
+            expired: List[Request] = []
             with self._lock:
                 while len(self._active) < self.n_slots:
                     req = self.queue.get_nowait()
                     if req is None:
                         break
+                    if req.expired():
+                        # queue-TTL shed at the admission boundary —
+                        # finishing outside the lock (_finish re-takes it)
+                        expired.append(req)
+                        continue
                     self._admit_locked(req)
                 active = list(self._active)
+            for req in expired:
+                self._finish(req, FINISH_EXPIRED,
+                             error="deadline expired in queue")
             if not active:
                 time.sleep(0.002)
                 continue
@@ -526,14 +549,19 @@ class WorkerServer:
 
     def __init__(self, engine, socket_path: str, *,
                  replica: int = 0, heartbeat_s: float = 0.5,
-                 max_frame_bytes: Optional[int] = None):
+                 max_frame_bytes: Optional[int] = None,
+                 incarnation: int = 0):
         self.engine = engine
         self.replica = replica
+        self.incarnation = incarnation
         self.heartbeat_s = heartbeat_s
+        self.rpc_stats = RpcStats()
         kw = {}
         if max_frame_bytes:
             kw["max_frame_bytes"] = max_frame_bytes
-        self.server = RpcServer(socket_path, self._handle, **kw)
+        self.server = RpcServer(socket_path, self._handle,
+                                stats=self.rpc_stats,
+                                span_hook=self._rpc_span, **kw)
         self._lock = threading.Lock()
         self._entries: Dict[int, _WEntry] = {}         # guarded-by: _lock
         self._events: "_stdqueue.Queue[Optional[dict]]" = _stdqueue.Queue()
@@ -559,8 +587,26 @@ class WorkerServer:
 
     def _heartbeat_loop(self) -> None:
         while not self._stop.wait(self.heartbeat_s):
+            # paired (wall, mono) stamps: the supervisor's between-RPC
+            # clock-offset signal, and the honest base for heartbeat-age
+            # math (receipt time includes pipe latency; this doesn't)
             self._push({"ev": "heartbeat", "pid": os.getpid(),
+                        "wall": time.time(), "mono": time.monotonic(),
+                        "incarnation": self.incarnation,
                         "snapshot": self.engine.service_snapshot()})
+
+    # -- observability -----------------------------------------------------
+
+    def _rpc_span(self, method: str, trace: dict, t0_wall: float,
+                  dur_s: float, ok: bool) -> None:
+        """RpcServer span hook: one ``rpc`` span row per traced frame —
+        the server-handle half of the hop (the client logs its
+        send→reply wait as an ``rpc:<method>`` child on the request
+        tree; the gap between the two IS the transport)."""
+        get_metrics().log_span(
+            "rpc", t0_wall, dur_s, cat="rpc", method=method,
+            request_id=trace.get("request_id"), replica=self.replica,
+            pid=os.getpid(), incarnation=self.incarnation, ok=ok)
 
     def _event_sender(self, sock) -> None:
         """Drains the event queue onto the subscribed connection. Peer
@@ -593,6 +639,7 @@ class WorkerServer:
             self._entries.pop(entry.client_id, None)
             if entry.stolen:
                 return              # handle now lives on another worker
+        self._emit_worker_span(entry)
         if req.error is None and req.finish_reason is not None \
                 and req.finish_reason not in ("error",):
             self._push({"ev": "done", "client_id": entry.client_id,
@@ -611,6 +658,25 @@ class WorkerServer:
                   piece: str) -> None:
         self._push({"ev": "piece", "client_id": client_id,
                     "token": int(tok), "piece": piece})
+
+    def _emit_worker_span(self, entry: _WEntry) -> None:
+        """The worker-process half of the request's span tree: the same
+        queued/prefill/decode shape as the engine's ``request`` root,
+        renamed ``worker_request``, keyed by the SUPERVISOR's request id
+        (the cross-process identity) and stamped with pid/incarnation —
+        the merged timeline joins it to the fleet's ``request`` root on
+        ``request_id``. Telemetry only: failures are swallowed."""
+        try:
+            row = entry.req.trace_row()
+            row["name"] = "worker_request"
+            row["local_request_id"] = row.get("request_id")
+            row["request_id"] = entry.client_id
+            row["replica"] = self.replica
+            row["pid"] = os.getpid()
+            row["incarnation"] = self.incarnation
+            get_metrics().log_span(**row)
+        except Exception:
+            logger.exception("worker_request span emit failed (ignored)")
 
     # -- control methods ---------------------------------------------------
 
@@ -641,8 +707,22 @@ class WorkerServer:
             return _jsonable(self.engine.stats())
         if method == "metrics":
             counters, gauges, hists = self.engine.metrics_snapshot()
-            return {"counters": dict(counters), "gauges": dict(gauges),
-                    "hists": {k: h.snapshot() for k, h in hists.items()}}
+            out = {"counters": dict(counters), "gauges": dict(gauges),
+                   "hists": {k: h.snapshot() for k, h in hists.items()}}
+            # server-side transport telemetry rides the same scrape: the
+            # fleet re-labels every series with worker/incarnation
+            for m, e in self.rpc_stats.snapshot().items():
+                lab = f'{{method="{m}"}}'
+                out["counters"][f"rpc_server_calls{lab}"] = e["calls"]
+                out["counters"][f"rpc_server_errors{lab}"] = e["errors"]
+                out["counters"][
+                    f"rpc_server_frame_bytes_received{lab}"] = \
+                    e["bytes_received"]
+                out["counters"][f"rpc_server_frame_bytes_sent{lab}"] = \
+                    e["bytes_sent"]
+                out["hists"][f"rpc_server_handle_seconds{lab}"] = \
+                    e["latency"]
+            return out
         if method == "export_panes":
             return self._rpc_export_panes()
         if method == "import_panes":
@@ -747,6 +827,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--spec", required=True,
                     help="EngineSpec JSON (inline or @/path/to/file)")
     ap.add_argument("--replica", type=int, default=0)
+    ap.add_argument("--incarnation", type=int, default=0,
+                    help="restart generation of this worker process "
+                         "(the supervisor's restart count); stamps "
+                         "telemetry + seeds a disjoint request-id range")
     ap.add_argument("--metrics_jsonl", default=None)
     ap.add_argument("--heartbeat_s", type=float, default=0.5)
     ap.add_argument("--drain_timeout", type=float, default=30.0)
@@ -761,17 +845,28 @@ def main(argv: Optional[List[str]] = None) -> int:
     if spec.fake is None:
         apply_host_env(spec.devices)
     if args.metrics_jsonl:
+        # append mode: a restarted incarnation stacks its rows (own
+        # header first) onto the same per-replica file, so the victim's
+        # last rows and its successor's live in one artifact
         configure_metrics(args.metrics_jsonl,
                           run_metadata={"role": "fleet_worker",
                                         "replica": args.replica,
-                                        "pid": os.getpid()})
+                                        "incarnation": args.incarnation,
+                                        "pid": os.getpid()},
+                          append=True)
+    # worker-LOCAL request ids must never collide with the supervisor's
+    # fleet-wide ids (or another worker's) in merged telemetry: seed a
+    # disjoint per-(replica, incarnation) range
+    seed_request_ids((args.replica * 1000 + args.incarnation + 1)
+                     * 1_000_000)
 
     engine = build_engine(spec, replica=args.replica)
     engine.warmup()
     engine.start()
 
     server = WorkerServer(engine, args.socket, replica=args.replica,
-                          heartbeat_s=args.heartbeat_s)
+                          heartbeat_s=args.heartbeat_s,
+                          incarnation=args.incarnation)
     server.start()
 
     stop = threading.Event()

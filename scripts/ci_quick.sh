@@ -608,6 +608,13 @@ def wait_fleet(pred, what, deadline_s=300):
 hz = wait_fleet(lambda h: h.get("status") == "serving"
                 and h.get("workers_up") == 2, "2 workers serving")
 pids = {r["replica"]: r["pid"] for r in hz["replicas"]}
+# prime the aggregated-metrics cache while both workers are healthy:
+# the mid-outage scrape below must serve the victim's CACHED series
+with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=30) as r:
+    prom = r.read().decode()
+assert 'worker="0"' in prom and 'worker="1"' in prom, (
+    "per-worker label passthrough missing")
 
 def post(rec, out, i):
     body = json.dumps(rec).encode()
@@ -634,6 +641,28 @@ time.sleep(0.15)                                # let decode start
 victim = next(r["replica"] for r in healthz()["replicas"]
               if r["status"] == "serving")
 os.kill(pids[victim], signal.SIGKILL)
+# mid-outage aggregated /metrics: the victim's cached series keep being
+# served (marked STALE), the endpoint answers fast and never raises —
+# the real-engine respawn takes seconds, so 1.2s after the kill the
+# victim is reliably down and past the staleness bar
+time.sleep(1.2)
+t0 = time.monotonic()
+with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+    prom = r.read().decode()
+scrape_s = time.monotonic() - t0
+assert scrape_s < 1.0, f"/metrics took {scrape_s:.2f}s mid-outage"
+for line in prom.splitlines():                   # parseable exposition
+    assert line.startswith("#") or " " in line, f"bad prom line: {line}"
+import re as _re
+assert _re.search(
+    r'fleet_worker_metrics_stale\{worker="%d",incarnation="0"\} 1'
+    % victim, prom), "victim's staleness gauge not set"
+assert _re.search(r'worker="%d"' % victim, prom.replace(
+    "fleet_worker_metrics", "")), "victim's cached series dropped"
+assert "fleet_rpc_client_latency_seconds" in prom
+print(f"mid-outage /metrics ok in {scrape_s * 1e3:.0f} ms "
+      "(victim cached+stale)")
 for t in threads:
     t.join(timeout=300)
 assert len(results) == 10, f"lost responses: {sorted(results)}"
@@ -679,6 +708,11 @@ for wf in sorted(glob.glob(mj + ".worker*.jsonl")):
     recompiles += [r for r in wrows if r.get("event") == "recompile"]
 assert not recompiles, f"worker recompiled: {recompiles}"
 import shutil
+os.makedirs("/tmp/_ci_crossproc", exist_ok=True)
+shutil.copy(mj, "/tmp/_ci_crossproc/metrics.jsonl")
+for wf in glob.glob(mj + ".worker*.jsonl"):
+    shutil.copy(wf, "/tmp/_ci_crossproc/" + os.path.basename(
+        wf).replace(os.path.basename(mj), "metrics.jsonl"))
 shutil.copy(mj, "/tmp/_ci_crossproc_metrics.jsonl")
 print(f"cross-process fleet smoke ok: {len(ok)}/10 completed, "
       f"{len(died)} failed typed worker_dead, 0 lost; worker {victim} "
@@ -690,6 +724,48 @@ render_out=$(JAX_PLATFORMS=cpu python scripts/summarize_metrics.py \
     /tmp/_ci_crossproc_metrics.jsonl) || exit 1
 echo "$render_out" | grep -q "cross-process fleet workers" || exit 1
 echo "worker-lifecycle renderer ok"
+# multi-file fleet view: fleet + worker JSONLs merged on the fleet
+# clock (clock_sync offsets), incarnations labeled per header
+render_out=$(JAX_PLATFORMS=cpu python scripts/summarize_metrics.py \
+    --fleet-dir /tmp/_ci_crossproc) || exit 1
+echo "$render_out" | grep -q "merged incident timeline" || exit 1
+echo "$render_out" | grep -q "fleet observability" || exit 1
+echo "fleet-dir renderer ok"
+# fleet observatory exporter: ONE merged skew-corrected Perfetto
+# timeline — every submitted request has exactly one closed span tree
+# spanning router+worker, rpc child spans ride along, and the victim's
+# death + restart incidents are visible on the merged timeline
+JAX_PLATFORMS=cpu python - <<'EOF' || exit 1
+import json
+from building_llm_from_scratch_tpu.obs.fleetview import (
+    export_fleet_trace,
+)
+meta = export_fleet_trace("/tmp/_ci_crossproc/metrics.jsonl",
+                          "/tmp/_ci_crossproc/fleet_trace.json")
+assert meta["n_request_spans"] >= 11, meta   # 10 + the post-restart one
+assert meta["n_worker_files"] == 2, meta
+assert meta["n_incarnations"] >= 3, meta     # 2 boots + 1 restart
+assert meta["n_flow_edges"] >= 1, meta       # cross-process span trees
+trace = json.load(open("/tmp/_ci_crossproc/fleet_trace.json"))
+# pid 1 = the fleet's request track; worker tracks (pid 10+) also carry
+# the engines' own local-id request spans, which are a different view
+req = [e for e in trace["traceEvents"] if e.get("ph") == "X"
+       and e.get("name") == "request" and e.get("pid") == 1]
+ids = [e["args"]["request_id"] for e in req]
+assert len(ids) == len(set(ids)), "a request emitted >1 span tree"
+assert all("outcome" in e["args"] and "worker" in e["args"]
+           for e in req)
+assert any(e.get("name", "").startswith("rpc:")
+           for e in trace["traceEvents"] if e.get("ph") == "X"), (
+    "no rpc child spans in the merged trace")
+names = {e["name"] for e in trace["traceEvents"] if e.get("ph") == "i"}
+assert "worker_dead" in names and "worker_restart" in names, names
+print(f"fleet exporter ok: {meta['n_request_spans']} request trees, "
+      f"{meta['n_worker_spans']} worker spans, "
+      f"{meta['n_flow_edges']} rpc edges across "
+      f"{meta['n_incarnations']} incarnations")
+EOF
+echo "fleet exporter ok"
 
 echo "== perf observatory gate (structural, timing-free, CPU) =="
 # The three debug-size micro-benches' structural HLO fingerprints —

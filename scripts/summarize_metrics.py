@@ -18,6 +18,7 @@ summary still works without it).
 """
 
 import argparse
+import glob
 import json
 import os
 import re
@@ -36,8 +37,19 @@ from building_llm_from_scratch_tpu.analysis.base import load_schema_module
 SCHEMA = load_schema_module()
 
 
-def load_rows(path):
-    header, metrics, events, health = None, [], [], []
+def load_segments(path):
+    """Parse one JSONL into per-run segments, split on ``header`` rows.
+
+    Fleet worker files hold one header per incarnation (a restarted
+    worker APPENDS to its file — serving/worker.py), so "one file = one
+    run" is no longer true; a consumer that merges blindly attributes a
+    whole restart history to one run and silently drops all but one
+    header. Returns ``[(header, metrics, events, health), ...]``, one
+    tuple per incarnation in file order (a headerless prefix becomes a
+    synthetic first segment with ``header=None``).
+    """
+    segments = []
+    current = None
     with open(path) as f:
         for i, line in enumerate(f):
             line = line.strip()
@@ -51,14 +63,40 @@ def load_rows(path):
                 continue
             kind = row.get("type")
             if kind == "header":
-                header = row
-            elif kind == "metrics":
-                metrics.append(row)
+                current = (row, [], [], [])
+                segments.append(current)
+                continue
+            if current is None:
+                current = (None, [], [], [])
+                segments.append(current)
+            if kind == "metrics":
+                current[1].append(row)
             elif kind == "event":
-                events.append(row)
+                current[2].append(row)
             elif kind == "health":
-                health.append(row)
+                current[3].append(row)
+    return segments
+
+
+def load_rows(path):
+    """(header, metrics, events, health) with every segment merged —
+    the whole-file view. The header is the FIRST one (a worker file's
+    later headers label incarnation segments, not the file)."""
+    segs = load_segments(path)
+    header = next((h for h, _m, _e, _h in segs if h is not None), None)
+    metrics = [r for s in segs for r in s[1]]
+    events = [r for s in segs for r in s[2]]
+    health = [r for s in segs for r in s[3]]
     return header, metrics, events, health
+
+
+def segment_label(header, ordinal):
+    """Stable label for one incarnation segment: fleet worker headers
+    carry replica/incarnation identity; anything else is run<N>."""
+    if header and header.get("replica") is not None:
+        return (f"replica{header['replica']}"
+                f".inc{header.get('incarnation', ordinal)}")
+    return f"run{ordinal}"
 
 
 def column(rows, key):
@@ -186,6 +224,7 @@ def summarize_serving(metrics, events):
     summarize_serving_resilience(failed, shed, expired, events)
     summarize_serving_fleet(done, metrics, events)
     summarize_worker_lifecycle(events)
+    summarize_fleet_observability(events)
     summarize_adapters(done, failed, events)
     summarize_prefix_kv(metrics, events)
     summarize_spec(done, metrics, events)
@@ -312,6 +351,124 @@ def summarize_worker_lifecycle(events):
         print(f"    pane handoff total: {len(handoffs)} transfer(s), "
               f"{total:,} bytes (adoptees serve shared prefixes "
               "without recompute)")
+
+
+def _clock_table(events):
+    """(replica, incarnation) -> (offset_s, uncertainty_s, n_samples)
+    from ``clock_sync`` events — the lowest-uncertainty sample wins per
+    worker incarnation (serving/fleet.py emits one whenever the RPC
+    round-trip tightens the estimate). Subtracting ``offset_s`` from a
+    worker-file timestamp lands it on the fleet's wall clock."""
+    best = {}
+    for e in events:
+        if e.get("event") != "clock_sync":
+            continue
+        key = (e.get("replica"), e.get("incarnation", 0))
+        unc = e.get("uncertainty_s")
+        if not isinstance(unc, (int, float)):
+            unc = float("inf")
+        if key not in best or unc <= best[key][1]:
+            best[key] = (e.get("offset_s") or 0.0, unc,
+                         e.get("n_samples"))
+    return best
+
+
+def summarize_fleet_observability(events):
+    """Fleet observatory section: per-incarnation clock offsets with
+    their round-trip uncertainty bound, and any incident-ring snapshots
+    the fleet wrote on worker death / restart-budget exhaustion."""
+    table = _clock_table(events)
+    snaps = [e for e in events if e.get("event") == "incident_snapshot"]
+    if not (table or snaps):
+        return
+    print("  -- fleet observability --")
+    for rep, inc in sorted(table, key=lambda k: (str(k[0]), str(k[1]))):
+        off, unc, n = table[(rep, inc)]
+        unc_txt = "inf" if unc == float("inf") else f"{1e6 * unc:.0f}"
+        print(f"    clock: replica {rep} inc {inc}: offset "
+              f"{1e6 * off:+.0f} us +/- {unc_txt} us"
+              + (f" ({n} samples)" if n else "")
+              + " (worker wall minus fleet wall)")
+    for e in snaps:
+        print(f"    incident snapshot ({e.get('reason')}): "
+              f"{e.get('n_events', '?')} ring events -> {e.get('path')}")
+
+
+def summarize_fleet_files(paths, trace=None):
+    """Cross-file fleet view: one fleet JSONL plus N append-mode worker
+    files (one header per incarnation each). Prints each file's
+    identity, a merged worker-lifecycle incident timeline with worker
+    rows shifted onto the fleet clock via the fleet file's
+    ``clock_sync`` offsets, the observability table, and then the full
+    single-run rendering of the fleet file itself."""
+    loaded = [(p,) + load_rows(p) for p in paths]
+
+    def _is_fleet(events):
+        return any(e.get("event") in ("worker_spawn", "clock_sync")
+                   for e in events)
+
+    fleet = next((t for t in loaded if _is_fleet(t[3])), loaded[0])
+    fpath = fleet[0]
+    offsets = _clock_table(fleet[3])
+    print(f"== fleet view: {len(paths)} file(s) ==")
+    merged = []                       # (fleet-clock time, source tag, event)
+    for p, _h, _m, ev, _hl in loaded:
+        segs = load_segments(p)
+        hdr = next((s[0] for s in segs if s[0]), None) or {}
+        if p == fpath:
+            merged += [(e.get("time", 0.0), "fleet", e) for e in ev]
+            detail = ""
+        else:
+            parts = []
+            for i, (sh, _sm, sev, _shl) in enumerate(segs):
+                rep = (sh or {}).get("replica")
+                inc = (sh or {}).get("incarnation", i)
+                off = offsets.get((rep, inc), (0.0,))[0]
+                tag = f"w{rep}.i{inc}"
+                merged += [(e.get("time", 0.0) - off, tag, e)
+                           for e in sev]
+                n_done = sum(1 for e in sev
+                             if e.get("event") == "request_done")
+                parts.append(f"inc{inc}: {n_done} done")
+            detail = f" ({len(segs)} incarnation(s): " + ", ".join(
+                parts) + ")"
+        role = hdr.get("role", "run")
+        rep = hdr.get("replica")
+        print(f"  {p}: {role}"
+              + (f" replica {rep}" if rep is not None else "") + detail)
+    incidents = sorted(
+        (t for t in merged if t[2].get("event") in SCHEMA.INCIDENT_EVENTS),
+        key=lambda t: t[0])
+    if incidents:
+        t0 = incidents[0][0]
+        print("  -- merged incident timeline (fleet clock, "
+              "skew-corrected) --")
+        for t, tag, e in incidents:
+            extra = e.get("reason") or e.get("phase") or ""
+            print(f"    t+{t - t0:7.2f}s  [{tag:<7}] {e['event']}"
+                  + (f" replica {e.get('replica')}"
+                     if e.get("replica") is not None else "")
+                  + (f" ({extra})" if extra else ""))
+    summarize_fleet_observability(fleet[3])
+    print(f"\n== fleet file: {fpath} ==")
+    _p, header, metrics, events, health = fleet
+    summarize(header, metrics, events)
+    summarize_compile(metrics, events)
+    summarize_fleet(metrics, events, health)
+    summarize_serving(metrics, events)
+    summarize_health(health)
+    if trace:
+        # lazy: obs pulls in jax; only the trace path needs it
+        from building_llm_from_scratch_tpu.obs.fleetview import (
+            export_fleet_trace)
+        workers = [p for p, *_ in loaded if p != fpath]
+        meta = export_fleet_trace(fpath, trace, workers)
+        print(f"\nfleet chrome trace written to {trace} "
+              f"({meta.get('n_request_spans', 0)} fleet spans, "
+              f"{meta.get('n_worker_spans', 0)} worker spans, "
+              f"{meta.get('n_flow_edges', 0)} flow edges across "
+              f"{meta.get('n_incarnations', 0)} incarnation(s)) — open in "
+              "https://ui.perfetto.dev")
 
 
 def summarize_adapters(done, failed, events):
@@ -770,10 +927,25 @@ def run_stats(path):
     step-timeline segments (s/step), engine tick phases (s/tick p50/p95),
     request-latency percentiles, throughput, compile totals. Only
     sections the file actually has appear — a train run compares on
-    segments, a serve run on tick phases and latencies."""
-    header, metrics, events, _health = load_rows(path)
-    stats = {"path": path, "n_metric_rows": len(metrics),
-             "n_events": len(events)}
+    segments, a serve run on tick phases and latencies. Files holding
+    several incarnations (append-mode fleet workers) additionally get an
+    ``incarnations`` dict of per-segment sub-stats keyed by
+    ``replicaR.incK`` so restart histories never blur into one run."""
+    segments = load_segments(path)
+    metrics = [r for s in segments for r in s[1]]
+    events = [r for s in segments for r in s[2]]
+    stats = {"path": path}
+    stats.update(_stats_from_rows(metrics, events))
+    if len(segments) > 1:
+        stats["n_incarnations"] = len(segments)
+        stats["incarnations"] = {
+            segment_label(h, i): _stats_from_rows(m, ev)
+            for i, (h, m, ev, _hl) in enumerate(segments)}
+    return stats
+
+
+def _stats_from_rows(metrics, events):
+    stats = {"n_metric_rows": len(metrics), "n_events": len(events)}
     segs = {}
     for seg in SCHEMA.TRAIN_SEGMENTS:
         rows = [r for r in metrics
@@ -951,8 +1123,13 @@ def plot(metrics, out_path):
 
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("jsonl", nargs="?", default=None,
-                   help="metrics JSONL written by --metrics_jsonl")
+    p.add_argument("jsonl", nargs="*", default=None,
+                   help="metrics JSONL written by --metrics_jsonl; pass "
+                        "several (fleet file + its .workerN.jsonl files) "
+                        "for the merged skew-corrected fleet view")
+    p.add_argument("--fleet-dir", default=None, metavar="DIR",
+                   help="summarize every *.jsonl in DIR as one fleet "
+                        "(equivalent to listing them positionally)")
     p.add_argument("--out", default=None,
                    help="figure path (default: <jsonl dir>/metrics.png)")
     p.add_argument("--trace", default=None, metavar="TRACE_JSON",
@@ -970,9 +1147,17 @@ def main(argv=None):
     if args.compare:
         compare_runs(*args.compare)
         return
-    if not args.jsonl:
-        p.error("a metrics JSONL path is required (or use --compare A B)")
-    header, metrics, events, health = load_rows(args.jsonl)
+    paths = list(args.jsonl or [])
+    if args.fleet_dir:
+        paths += sorted(glob.glob(os.path.join(args.fleet_dir, "*.jsonl")))
+    if not paths:
+        p.error("a metrics JSONL path is required (or use --fleet-dir / "
+                "--compare A B)")
+    if len(paths) > 1:
+        summarize_fleet_files(paths, trace=args.trace)
+        return
+    path = paths[0]
+    header, metrics, events, health = load_rows(path)
     summarize(header, metrics, events)
     summarize_compile(metrics, events)
     summarize_fleet(metrics, events, health)
@@ -983,14 +1168,14 @@ def main(argv=None):
             export_chrome_trace,
         )
 
-        meta = export_chrome_trace(args.jsonl, args.trace)
+        meta = export_chrome_trace(path, args.trace)
         print(f"trace written to {args.trace} "
               f"({meta['n_request_spans']} request spans, "
               f"{meta['n_tick_windows']} tick windows, "
               f"{meta['n_train_windows']} train windows)")
     if metrics:
         out = args.out or os.path.join(
-            os.path.dirname(os.path.abspath(args.jsonl)), "metrics.png")
+            os.path.dirname(os.path.abspath(path)), "metrics.png")
         plot(metrics, out)
 
 

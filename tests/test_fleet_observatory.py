@@ -1,0 +1,485 @@
+"""Fleet observatory tests (serving/transport.py instrumentation,
+serving/fleet.py span closure + aggregated /metrics, obs/fleetview.py
+merged exporter, scripts/summarize_metrics.py incarnation handling).
+
+The cross-process tracing contract: EVERY submitted request yields
+exactly ONE closed span tree on the fleet's JSONL — done, shed,
+rejected, expired, or killed mid-decode — carrying request_id + worker
+labels and the ``rpc:<method>`` hops as children; worker files join on
+the same request id. The aggregated ``/metrics`` endpoint answers from
+cached per-worker series (with a staleness gauge) while a worker is
+down, in well under a second. The merged exporter is deterministic and
+shifts worker rows onto the fleet clock using ``clock_sync`` offsets.
+"""
+
+import importlib.util
+import json
+import os
+import re
+import signal
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from building_llm_from_scratch_tpu.obs import configure_metrics
+from building_llm_from_scratch_tpu.serving import (
+    EngineSpec,
+    ProcessFleet,
+    SamplingParams,
+)
+from building_llm_from_scratch_tpu.serving.queue import (
+    QueueFullError,
+    SLOShedError,
+)
+from building_llm_from_scratch_tpu.serving.transport import (
+    RpcClient,
+    RpcServer,
+    RpcStats,
+)
+
+@pytest.fixture
+def sink(tmp_path):
+    path = tmp_path / "metrics.jsonl"
+    logger = configure_metrics(str(path), run_metadata={"test": True})
+    yield str(path)
+    logger.close()
+    configure_metrics(None)
+
+
+def load_rows(path):
+    return [json.loads(line) for line in open(path)]
+
+
+def fake_spec(**fake_kw):
+    fake = dict(n_slots=2, max_queue=32, tpot_s=0.01,
+                default_max_new_tokens=8, vocab_size=96)
+    fake.update(fake_kw)
+    return EngineSpec(fake=fake)
+
+
+def make_fleet(n=2, tmp_path=None, spec=None, **kw):
+    kw.setdefault("heartbeat_s", 0.1)
+    kw.setdefault("heartbeat_timeout_s", 5.0)
+    kw.setdefault("max_restarts", 2)
+    kw.setdefault("restart_backoff_s", 0.2)
+    kw.setdefault("ready_timeout_s", 120.0)
+    if tmp_path is not None:
+        kw.setdefault("socket_dir", str(tmp_path / "socks"))
+        os.makedirs(kw["socket_dir"], exist_ok=True)
+    return ProcessFleet(spec or fake_spec(), n, **kw)
+
+
+def wait_for(pred, timeout, what):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def load_summarize_metrics():
+    """scripts/ is not a package: load the renderer by file path (the
+    same jax-free loading discipline the script itself uses)."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(root, "scripts", "summarize_metrics.py")
+    spec = importlib.util.spec_from_file_location("_summarize_metrics",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["_summarize_metrics"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# -- raw transport instrumentation ---------------------------------------
+
+
+def test_rpc_stats_latency_seconds_and_frame_bytes(tmp_path):
+    """Per-method client/server histograms count in SECONDS and the
+    frame-byte counters match real frame traffic; every reply carries
+    the ``srv`` clock stamp that feeds the client's offset sample."""
+    path = str(tmp_path / "rpc.sock")
+    server_stats = RpcStats()
+    seen_traces = []
+
+    def handler(method, args, sock):
+        if method == "boom":
+            raise ValueError("no")
+        time.sleep(0.01)
+        return {"echo": args.get("x")}
+
+    srv = RpcServer(path, handler, stats=server_stats,
+                    span_hook=lambda m, tr, t0, dur, ok:
+                        seen_traces.append((m, tr, ok)))
+    srv.start()
+    cli_stats = RpcStats()
+    cli = RpcClient(path, timeout=5.0, stats=cli_stats)
+    timings = []
+    try:
+        for i in range(3):
+            out = cli.call("echo", x=i, trace_ctx={"request_id": 42},
+                           on_timing=timings.append)
+            assert out == {"echo": i}
+        with pytest.raises(ValueError):
+            cli.call("boom")
+    finally:
+        cli.close()
+        srv.stop()
+
+    for stats, side in ((cli_stats, "client"), (server_stats, "server")):
+        snap = stats.snapshot()
+        e = snap["echo"]
+        assert e["calls"] == 3 and e["errors"] == 0, side
+        lat = e["latency"]
+        # seconds units: 3 calls of a 10ms handler sum to [0.03, 3.0)
+        # — a ms-unit regression would put the sum at 30+
+        assert 0.03 <= lat["sum"] < 3.0, (side, lat)
+        assert lat["count"] == 3
+        assert snap["boom"]["errors"] == 1
+    ce = cli_stats.snapshot()["echo"]
+    assert ce["bytes_sent"] > 0 and ce["bytes_received"] > 0
+    # the server received exactly what the client sent
+    assert server_stats.snapshot()["echo"]["bytes_received"] == \
+        ce["bytes_sent"]
+    # timing hook: one dict per call with the rpc-child-span fields
+    assert len(timings) == 3
+    for t in timings:
+        assert t["method"] == "echo"
+        assert 0.0 < t["dur_s"] < 3.0
+        assert t["bytes_sent"] > 0
+    # trace context reached the server's span hook, errors flagged
+    assert [m for m, _, _ in seen_traces] == ["echo"] * 3
+    assert all(tr == {"request_id": 42} and ok
+               for _, tr, ok in seen_traces)
+    # clock sample: NTP midpoint on a local socket is sub-second tight
+    clock = cli.clock
+    assert clock is not None and clock.rtt_s > 0.0
+    assert abs(clock.offset_s) < 1.0
+    assert clock.uncertainty_s == pytest.approx(clock.rtt_s / 2.0)
+
+
+# -- the cross-process span audit ----------------------------------------
+
+
+@pytest.mark.slow
+def test_span_audit_one_closed_tree_per_outcome(tmp_path, sink):
+    """One request per outcome — done, shed (tight deadline), rejected
+    (queue full), expired (deadline passed while queued), worker_dead
+    (kill -9 mid-decode) — through a 2-worker fleet: the fleet JSONL
+    holds exactly ONE closed ``request`` span per request id, labeled
+    with request_id/worker/incarnation and carrying ``rpc:`` children;
+    worker files join on the same ids, and the victim's file stacks one
+    header per incarnation (the run_stats regression)."""
+    spec = fake_spec(n_slots=1, max_queue=2, tpot_s=0.05)
+    fleet = make_fleet(2, tmp_path, spec=spec, metrics_base=sink,
+                       max_restarts=1).start()
+    try:
+        # outcome: done
+        h_done = fleet.submit(np.array([3], np.int32),
+                              SamplingParams(max_new_tokens=4),
+                              block=True, timeout=10.0)
+        h_done.result(timeout=30.0)
+        # outcome: shed — deadline below the engine's own decode
+        # estimate, refused by every worker at submit
+        with pytest.raises(SLOShedError):
+            fleet.submit(np.array([5], np.int32),
+                         SamplingParams(max_new_tokens=8,
+                                        deadline_s=0.01))
+        # saturate both single-slot workers with long decodes
+        blockers = [fleet.submit(np.array([10 + i], np.int32),
+                                 SamplingParams(max_new_tokens=60),
+                                 block=True, timeout=10.0)
+                    for i in range(2)]
+        time.sleep(0.2)
+        # outcome: expired — passes the shed estimate but its deadline
+        # lapses while queued behind a blocker
+        h_exp = fleet.submit(np.array([20], np.int32),
+                             SamplingParams(max_new_tokens=2,
+                                            deadline_s=0.2))
+        # outcome: rejected — fill every queue slot until a submit is
+        # refused by both workers
+        fillers, rejected = [], False
+        for i in range(8):
+            try:
+                fillers.append(fleet.submit(
+                    np.array([30 + i], np.int32),
+                    SamplingParams(max_new_tokens=2)))
+            except QueueFullError:
+                rejected = True
+                break
+        assert rejected, "queues never filled"
+        for h in blockers + fillers:
+            h.result(timeout=30.0)
+        with pytest.raises(Exception, match="expired"):
+            h_exp.result(timeout=30.0)
+        # outcome: worker_dead — kill the serving worker mid-decode
+        h_dead = fleet.submit(np.array([50], np.int32),
+                              SamplingParams(max_new_tokens=60),
+                              block=True, timeout=10.0)
+        time.sleep(0.2)
+        victim = h_dead.route["replica"]
+        os.kill(fleet.workers[victim].pid, signal.SIGKILL)
+        with pytest.raises(RuntimeError, match="worker_dead"):
+            h_dead.result(timeout=60.0)
+        wait_for(lambda: fleet.stats()["worker_restarts"] == 1, 30.0,
+                 "the victim to restart (second incarnation)")
+    finally:
+        fleet.shutdown(drain=False)
+
+    rows = load_rows(sink)
+    events = [r for r in rows if r.get("type") == "event"]
+    shed_ids = [e["request_id"] for e in events
+                if e["event"] == "request_shed"]
+    rej_ids = [e["request_id"] for e in events
+               if e["event"] == "request_rejected"]
+    assert len(shed_ids) == 1 and len(rej_ids) == 1
+    expect = {h_done.id: "length", shed_ids[0]: "shed",
+              rej_ids[0]: "rejected", h_exp.id: "expired",
+              h_dead.id: "error"}
+    for h in blockers + fillers:
+        expect[h.id] = "length"
+
+    spans = [r for r in rows if r.get("type") == "span"
+             and r.get("name") == "request"]
+    by_id = {}
+    for s in spans:
+        assert s["request_id"] not in by_id, (
+            f"request {s['request_id']} emitted more than one tree")
+        by_id[s["request_id"]] = s
+    assert set(by_id) == set(expect), "a submitted request left no tree"
+    for rid, outcome in expect.items():
+        s = by_id[rid]
+        assert s["outcome"] == outcome, (rid, s)
+        assert isinstance(s["worker"], int) and s["worker"] >= 0
+        assert isinstance(s["incarnation"], int)
+        assert s["dur_s"] >= 0.0
+        kids = s.get("children") or []
+        assert any(c["name"].startswith("rpc:") for c in kids), (
+            f"request {rid} ({outcome}) has no rpc child spans")
+        for c in kids:   # closed tree: children inside the root
+            assert c["t0"] >= s["t0"]
+            assert c["t0"] + c["dur_s"] <= s["t0"] + s["dur_s"] + 1e-6
+    assert by_id[h_dead.id]["worker"] == victim
+
+    # worker files join on the same fleet request ids
+    worker_spans = {}
+    for i in range(2):
+        wrows = load_rows(f"{sink}.worker{i}.jsonl")
+        for r in wrows:
+            if r.get("type") == "span" and r.get("name") == \
+                    "worker_request":
+                worker_spans.setdefault(r["request_id"], []).append(r)
+    assert set(worker_spans) <= set(expect)
+    for rid in [h_done.id] + [h.id for h in blockers + fillers]:
+        assert len(worker_spans[rid]) == 1, (
+            f"completed request {rid} must have exactly one worker span")
+        assert worker_spans[rid][0].get("replica") is not None
+        assert worker_spans[rid][0].get("pid") is not None
+
+    # clock_sync samples cover the victim's BOTH incarnations
+    sync = [e for e in events if e["event"] == "clock_sync"]
+    assert sync, "no clock_sync events on the fleet file"
+    for e in sync:
+        assert isinstance(e["offset_s"], (int, float))
+        assert e["uncertainty_s"] >= 0.0
+        assert abs(e["offset_s"]) < 1.0       # same host: tiny skew
+    assert {(e["replica"], e.get("incarnation")) for e in sync} >= {
+        (victim, 0), (victim, 1)}
+
+    # the victim's file stacks one header per incarnation, and the
+    # renderer's run_stats splits + labels them (the regression the
+    # append-mode files used to break)
+    victim_file = f"{sink}.worker{victim}.jsonl"
+    headers = [r for r in load_rows(victim_file)
+               if r.get("type") == "header"]
+    assert [(h["replica"], h["incarnation"]) for h in headers] == [
+        (victim, 0), (victim, 1)]
+    sm = load_summarize_metrics()
+    stats = sm.run_stats(victim_file)
+    assert stats["n_incarnations"] == 2
+    assert set(stats["incarnations"]) == {
+        f"replica{victim}.inc0", f"replica{victim}.inc1"}
+    assert len(sm.load_segments(victim_file)) == 2
+
+    # merged exporter over the real artifacts: every request tree
+    # survives the merge and the death/restart incidents are visible
+    from building_llm_from_scratch_tpu.obs.fleetview import (
+        export_fleet_trace,
+    )
+    out = str(tmp_path / "fleet_trace.json")
+    meta = export_fleet_trace(sink, out)
+    assert meta["n_request_spans"] == len(expect)
+    assert meta["n_incarnations"] == 3       # 2 workers + 1 restart
+    assert meta["n_flow_edges"] >= 1
+    trace = json.load(open(out))
+    instants = [e for e in trace["traceEvents"] if e.get("ph") == "i"]
+    assert any(e["name"] == "worker_dead" for e in instants)
+    assert any(e["name"] == "worker_restart" for e in instants)
+
+
+# -- aggregated /metrics under outage ------------------------------------
+
+
+@pytest.mark.slow
+def test_aggregated_metrics_cached_and_stale_during_outage(tmp_path,
+                                                           sink):
+    fleet = make_fleet(2, tmp_path, metrics_base=sink,
+                       max_restarts=0).start()
+    try:
+        # heartbeats carry PAIRED (wall, monotonic) stamps; the control
+        # channel holds a live NTP-style clock sample per worker
+        w0 = fleet.workers[0]
+        wait_for(lambda: w0.last_beat_wall is not None, 10.0,
+                 "a paired-timestamp heartbeat")
+        assert abs(time.time() - w0.last_beat_wall) < 5.0
+        assert w0.ctrl.clock is not None
+        assert w0.ctrl.clock.rtt_s > 0.0
+        assert abs(w0.ctrl.clock.offset_s) < 1.0
+
+        h = fleet.submit(np.array([7], np.int32),
+                         SamplingParams(max_new_tokens=4), block=True,
+                         timeout=10.0)
+        h.result(timeout=30.0)
+        text = fleet.prometheus_text()       # also primes the cache
+        assert re.search(r'fleet_workers_up 2(\.0)?\b', text)
+        for i in (0, 1):
+            assert re.search(
+                r'fleet_worker_metrics_stale\{worker="%d",'
+                r'incarnation="0"\} 0(\.0)?\b' % i, text), text
+        # per-worker label passthrough on the workers' own series
+        assert re.search(r'serve_requests_finished[^\n]*worker="0"',
+                         text)
+        assert re.search(r'worker="1"', text)
+        # the fleet's per-method rpc instrumentation
+        assert re.search(
+            r'fleet_rpc_client_calls_total\{method="ping"\} [1-9]',
+            text)
+        assert 'fleet_rpc_client_latency_seconds' in text
+        assert re.search(
+            r'fleet_rpc_client_frame_bytes_sent_total\{method="submit"\}'
+            r' [1-9]', text)
+
+        os.kill(fleet.workers[0].pid, signal.SIGKILL)
+        wait_for(lambda: fleet.stats()["worker_deaths"] == 1, 10.0,
+                 "the death to be detected")
+        time.sleep(1.0)                      # age past the staleness bar
+        t0 = time.monotonic()
+        text = fleet.prometheus_text()
+        dt = time.monotonic() - t0
+        assert dt < 1.0, f"/metrics blocked {dt:.2f}s during outage"
+        assert re.search(r'fleet_workers_up 1(\.0)?\b', text)
+        # the dead worker's cached series are still served, marked stale
+        assert re.search(
+            r'fleet_worker_metrics_stale\{worker="0",incarnation="0"\} '
+            r'1(\.0)?\b', text), text
+        assert re.search(
+            r'fleet_worker_metrics_stale\{worker="1",incarnation="0"\} '
+            r'0(\.0)?\b', text)
+        assert re.search(r'serve_requests_finished[^\n]*worker="0"',
+                         text)
+        assert re.search(r'fleet_worker_deaths_total 1\b', text)
+    finally:
+        fleet.shutdown(drain=False)
+
+    # the flight recorder snapshotted its ring on death + budget
+    # exhaustion, and said so on the fleet's JSONL
+    snaps = sorted(
+        p for p in os.listdir(os.path.dirname(sink))
+        if re.match(r"metrics\.jsonl\.incident\d+\.json$", p))
+    assert snaps, "no incident snapshot written"
+    payload = json.load(open(os.path.join(os.path.dirname(sink),
+                                          snaps[0])))
+    assert payload["reason"].startswith("worker_dead")
+    assert payload["n_events"] >= 1
+    kinds = [e["kind"] for e in payload["events"]]
+    assert "worker_spawn" in kinds and "worker_dead" in kinds
+    ev = [r for r in load_rows(sink) if r.get("type") == "event"
+          and r.get("event") == "incident_snapshot"]
+    assert ev and ev[0]["reason"].startswith("worker_dead")
+    assert os.path.basename(ev[0]["path"]) == snaps[0]
+
+
+# -- exporter determinism + skew correction on fixtures ------------------
+
+
+def _write_jsonl(path, rows):
+    with open(path, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+
+
+def test_fleet_exporter_deterministic_and_skew_corrected(tmp_path):
+    """Fixture fleet+worker files with a KNOWN 0.5s clock skew: the
+    exporter lands the worker span at the fleet-clock instant, keeps
+    every incarnation, and two exports are byte-identical."""
+    fleet_jsonl = str(tmp_path / "m.jsonl")
+    _write_jsonl(fleet_jsonl, [
+        {"type": "header", "time": 1000.0, "schema_version": 10},
+        {"type": "event", "time": 1000.1, "event": "clock_sync",
+         "replica": 0, "incarnation": 0, "offset_s": 0.5,
+         "uncertainty_s": 0.001, "rtt_s": 0.002, "n_samples": 3},
+        {"type": "span", "time": 1001.0, "name": "request",
+         "cat": "request", "t0": 1000.2, "dur_s": 0.5,
+         "children": [{"name": "rpc:submit", "t0": 1000.2,
+                       "dur_s": 0.01}],
+         "request_id": 7, "outcome": "length", "worker": 0,
+         "incarnation": 0},
+        {"type": "event", "time": 1000.9, "event": "worker_dead",
+         "replica": 0, "reason": "test"},
+    ])
+    worker_jsonl = fleet_jsonl + ".worker0.jsonl"
+    _write_jsonl(worker_jsonl, [
+        {"type": "header", "time": 1000.6, "schema_version": 10,
+         "replica": 0, "incarnation": 0, "pid": 111,
+         "role": "fleet_worker"},
+        # worker clock runs 0.5s AHEAD: uncorrected, this span would
+        # render 0.5s after the rpc that delivered it
+        {"type": "span", "time": 1000.8, "name": "worker_request",
+         "cat": "request", "t0": 1000.7, "dur_s": 0.4,
+         "request_id": 7, "local_request_id": 1, "replica": 0,
+         "outcome": "length"},
+        {"type": "header", "time": 1002.0, "schema_version": 10,
+         "replica": 0, "incarnation": 1, "pid": 222,
+         "role": "fleet_worker"},
+        {"type": "span", "time": 1002.5, "name": "worker_request",
+         "cat": "request", "t0": 1002.4, "dur_s": 0.1,
+         "request_id": 9, "local_request_id": 1, "replica": 0,
+         "outcome": "length"},
+    ])
+
+    from building_llm_from_scratch_tpu.obs.fleetview import (
+        export_fleet_trace,
+    )
+    out_a = str(tmp_path / "a.json")
+    out_b = str(tmp_path / "b.json")
+    meta = export_fleet_trace(fleet_jsonl, out_a)
+    export_fleet_trace(fleet_jsonl, out_b)
+    assert open(out_a, "rb").read() == open(out_b, "rb").read(), (
+        "exporter output must be deterministic")
+
+    assert meta["n_request_spans"] == 1
+    assert meta["n_worker_files"] == 1
+    assert meta["n_incarnations"] == 2
+    assert meta["n_worker_spans"] == 2
+    assert meta["n_flow_edges"] == 1
+    off = meta["clock_offsets_s"]["worker0.inc0"]
+    assert off["offset_s"] == pytest.approx(0.5)
+    assert off["uncertainty_s"] == pytest.approx(0.001)
+    # inc1 never got its own sample: it inherits the replica's best
+    assert meta["clock_offsets_s"]["worker0.inc1"]["offset_s"] == \
+        pytest.approx(0.5)
+
+    trace = json.load(open(out_a))
+    slices = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+    fleet_span = next(e for e in slices if e["name"] == "request")
+    worker_span = next(e for e in slices
+                       if e["name"] == "worker_request"
+                       and e["args"].get("request_id") == 7)
+    # skew-corrected: 1000.7 − 0.5 == the fleet span's own t0
+    assert worker_span["ts"] == pytest.approx(fleet_span["ts"], abs=1.0)
+    flows = [e for e in trace["traceEvents"]
+             if e.get("ph") in ("s", "f")]
+    assert {e["ph"] for e in flows} == {"s", "f"}
+    assert len({e["id"] for e in flows}) == 1
