@@ -1018,6 +1018,127 @@ def bench_serve_prefix(n_requests=10, prefix_len=192, suffix_len=8,
                    unit="tokens/sec", detail=detail)
 
 
+def bench_serve_mem(n_requests=12, prefix_len=192, suffix_len=8,
+                    max_new=16, n_slots=4, chunk=64):
+    """Shared-prefix LIVE-BYTES A/B for the memory observatory
+    (obs/memory.py): the same workload as ``serve_prefix`` —
+    ``n_requests`` requests sharing one ``prefix_len``-token system
+    prompt — but the measured quantity is MEMORY, not latency. Every
+    number comes off the engine's ``MemoryLedger`` (byte-exact pytree
+    ``nbytes`` sums), never re-derived from shape formulas.
+
+    Two arms over the SAME requests:
+      - ``prefix_off``: chunked prefill, prefix cache OFF — every slot
+        recomputes AND stores its own copy of the shared prefix;
+      - ``prefix_on``: prefix cache ON — the store holds ONE pane set,
+        successors copy it into their slot instead of prefilling it.
+
+    Reported per arm: slot-cache resident bytes (the fixed carve-out),
+    per-tenant live-KV peak from the ledger's labeled series, and the
+    summed ``kv_bytes_peak`` over request_done. The prefix arm adds
+    ``prefix_bytes_saved`` (KV bytes NOT re-prefilled thanks to hits)
+    and ``pane_copy_duplication_x`` — live KV at peak still holds up to
+    ``n_slots`` COPIES of panes the store holds once, because the hit
+    path copies panes into the slot carve-out. That duplication factor
+    is the committed baseline a paged/shared-block KV design (ROADMAP
+    item 1) must collapse toward 1x; the headline is total
+    ``prefix_bytes_saved`` so the trajectory row records today's
+    copy-based savings next to the duplication it leaves on the table.
+
+    bf16 on TPU, fp32 elsewhere (same policy as ``bench_serve``)."""
+    import tempfile
+
+    from building_llm_from_scratch_tpu.configs import get_config
+    from building_llm_from_scratch_tpu.generate import _bucket
+    from building_llm_from_scratch_tpu.models import init_params
+    from building_llm_from_scratch_tpu.serving import (
+        DecodeEngine,
+        KVCachePolicy,
+        SamplingParams,
+    )
+
+    if _QUICK:
+        n_requests, max_new = min(n_requests, 6), min(max_new, 8)
+    dtype = "bf16" if jax.default_backend() == "tpu" else "fp32"
+    cfg = get_config("GPT2", "124M", dtype=dtype)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    prefix = rng.integers(0, cfg.vocab_size, (prefix_len,)).astype(np.int32)
+    prompts = [np.concatenate([
+        prefix, rng.integers(0, cfg.vocab_size,
+                             (suffix_len,)).astype(np.int32)])
+        for _ in range(n_requests)]
+    sp = SamplingParams(max_new_tokens=max_new, ignore_eos=True)
+    cap = prefix_len + suffix_len
+    max_len = _bucket(cap + max_new)
+
+    arms = {
+        "prefix_off": KVCachePolicy(prefill_chunk=chunk),
+        "prefix_on": KVCachePolicy(prefill_chunk=chunk, prefix_cache=True),
+    }
+    detail = {}
+    headline = None
+    from building_llm_from_scratch_tpu.obs import configure_metrics
+
+    jsonl_dir = tempfile.mkdtemp(prefix="bench_serve_mem_")
+    for arm, policy in arms.items():
+        # one telemetry file per arm (serve_load idiom): the
+        # memory_snapshot stream stays attributable to THIS arm
+        configure_metrics(os.path.join(jsonl_dir, f"{arm}.jsonl"),
+                          run_metadata={"bench": "serve_mem", "arm": arm,
+                                        "n_slots": n_slots,
+                                        "n_requests": n_requests})
+        # metrics_every=1: the ledger observes every tick, so the
+        # labeled kv_live_bytes peak is tick-accurate, not cadence-lossy
+        engine = DecodeEngine(cfg, params, n_slots=n_slots,
+                              max_len=max_len, max_queue=n_requests,
+                              warmup_prompt_cap=cap, kv_policy=policy,
+                              metrics_every=1)
+        engine.warmup()
+        handles = [engine.submit(p, sp, block=True) for p in prompts]
+        engine.run_until_idle()
+        for h in handles:
+            assert len(h.output_ids) == max_new, h.finish_reason
+        ledger = engine.memory_ledger
+        snap = ledger.snapshot()
+        gauges = ledger.gauges()
+        live_peak = max(
+            ledger.labeled_peaks.get("kv_live_bytes", {}).values(),
+            default=0)
+        row = {
+            "slot_kv_bytes": snap["slot_kv"] + snap.get("kv_scales", 0),
+            "kv_live_peak_bytes": live_peak,
+            "kv_bytes_peak_sum": sum(h.kv_bytes_peak for h in handles),
+            "mem_total_bytes": gauges["mem_total_bytes"],
+            "recompiles": engine.n_recompiles,
+        }
+        if engine.prefix_store is not None:
+            st = engine.prefix_store.stats()
+            saved = sum(h.prefix_bytes_saved for h in handles)
+            row["prefix_store_bytes"] = snap["prefix_store"]
+            row["prefix_hits"] = st["hits"]
+            row["prefix_bytes_saved"] = saved
+            if snap["prefix_store"]:
+                # peak live KV / the single stored pane set: how many
+                # resident COPIES of the shared prefix the slot
+                # carve-out holds at peak (the paged-KV target is ~1)
+                row["pane_copy_duplication_x"] = round(
+                    live_peak / snap["prefix_store"], 2)
+            headline = float(saved)
+        detail[arm] = row
+        engine.shutdown()
+        configure_metrics(None)              # close + detach the arm sink
+    off, on = detail["prefix_off"], detail["prefix_on"]
+    if off["kv_live_peak_bytes"]:
+        detail["live_peak_ratio_prefix"] = round(
+            on["kv_live_peak_bytes"] / off["kv_live_peak_bytes"], 3)
+    print(json.dumps(detail), flush=True)
+    return _result("serve_mem", f"serve_mem prefix_bytes_saved GPT2-124M "
+                   f"{dtype} {n_requests}req shared-{prefix_len}tok-prefix "
+                   f"chunk{chunk} slots{n_slots}", headline,
+                   unit="bytes", detail=detail)
+
+
 def _spec_bench_model(ctx=128, train_steps=60, period=7, seed=0):
     """A tiny byte-ish model TRAINED briefly on a cyclic token stream —
     the honest 'repetitive/greedy workload' for the speculative-decoding
@@ -1470,6 +1591,7 @@ BENCHES = {
     "serve_fleet": bench_serve_fleet,
     "serve_lora": bench_serve_lora,
     "serve_prefix": bench_serve_prefix,
+    "serve_mem": bench_serve_mem,
     "serve_spec": bench_serve_spec,
     "lora_fusion": bench_lora_fusion,
     "micro_train": bench_micro_train,

@@ -55,6 +55,22 @@ HOT_PATHS = {
         "DecodeEngine._verify_tick",
         "DecodeEngine._pool_args",
         "DecodeEngine._pool_args_for",
+        # memory-ledger providers: run at every cadence AND under the
+        # /metrics scrape — nbytes/host-numpy metadata only, a device
+        # fetch here would sync the tick (and stall every scrape)
+        "DecodeEngine._cache_component_bytes",
+        "DecodeEngine._kv_live_by_tenant",
+        "DecodeEngine._compile_temp_bytes",
+    },
+    "building_llm_from_scratch_tpu/obs/memory.py": {
+        # the ledger's measurement/export surface: providers read array
+        # METADATA (.nbytes) — explicit device polls live only in
+        # observe()'s cadence-bounded _poll(), never here
+        "MemoryLedger.snapshot",
+        "MemoryLedger.gauges",
+        "MemoryLedger.device_bytes",
+        "MemoryLedger.host_bytes",
+        "MemoryLedger.total_bytes",
     },
     "building_llm_from_scratch_tpu/serving/spec.py": {
         # the drafter runs INSIDE the tick for every spec-enabled slot:

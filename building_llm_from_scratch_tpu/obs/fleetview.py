@@ -47,6 +47,7 @@ from building_llm_from_scratch_tpu.obs.schema import (
 from building_llm_from_scratch_tpu.obs.trace import (
     _PID_REQUESTS,
     _instant,
+    _memory_counters,
     _meta,
     _num,
     _window_events,
@@ -198,7 +199,11 @@ def _segment_events(seg: _Segment, pid: int, base_s: float,
         elif kind == "event":
             t = _num(row, "time")
             name = row.get("event")
-            if t is not None and name in INCIDENT_EVENTS:
+            if t is not None and name == "memory_snapshot":
+                # each worker's HBM composition on its OWN process row,
+                # skew-corrected like every other worker timestamp
+                events += _memory_counters(row, pid, (t - base_s) * 1e6)
+            elif t is not None and name in INCIDENT_EVENTS:
                 n_incidents += 1
                 events.append(_instant(
                     str(name), pid, _TID_INCIDENTS, (t - base_s) * 1e6,

@@ -1,0 +1,449 @@
+"""Memory observatory: a byte-exact ledger of device (and host) memory.
+
+``MemoryLedger`` makes memory a first-class observed resource: every
+consumer of HBM registers a named **component** whose size is measured
+from the ACTUAL arrays (``nbytes`` sums over the live pytree — metadata
+reads, never re-derived formulas and never a device sync), and the
+ledger turns those into
+
+  - a composition **snapshot** per cadence window (the ``memory_snapshot``
+    event — pure ``nbytes`` math, so identical runs produce byte-identical
+    snapshots and the Chrome-trace counter tracks built from them are
+    deterministic);
+  - **drift** detection (the leak detector): a component whose measured
+    bytes diverge from its registered byte-exact expectation, a component
+    that only ever grows, a probe-reported invariant violation (e.g. a
+    prefix pane still pinned at a cadence boundary — pins are transient
+    by design), or ledger-vs-``device.memory_stats()`` divergence where
+    the platform reports stats — each emits ``memory_drift`` naming the
+    component;
+  - **pressure** detection (the near-OOM flight recorder): when device
+    components exceed ``pressure_frac`` of capacity, ``memory_pressure``
+    fires with the full component breakdown attached, so the post-mortem
+    has the composition at the moment headroom vanished. n/a-safe: on
+    CPU (no ``bytes_limit``) the headroom gauge is simply absent;
+  - labeled **attribution** series for ``/metrics`` (live KV bytes by
+    tenant, prefix-store bytes by namespace, adapter-pool bytes by
+    tenant) with per-label high watermarks.
+
+Sync discipline: providers return host ints computed from array METADATA
+(``.nbytes``, host-side numpy state). ``snapshot``/``observe``/``gauges``
+are registered GL01x hot paths (analysis/hostsync.py) — nothing in here
+may block the host on the device; the only host-side polls (``/proc``
+RSS, ``device.memory_stats()``) happen at cadence inside ``observe`` and
+never enter the deterministic snapshot values.
+
+One source of truth: ``utils/memory.py``'s ``device_memory_stats`` /
+``host_rss_bytes`` are polled ONLY through the ledger (the trainer's
+former ad-hoc gauges now read ``legacy_row()``), so HBM-in-use, peak and
+RSS can never disagree between surfaces.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from building_llm_from_scratch_tpu.utils.memory import (
+    device_memory_stats,
+    host_rss_bytes,
+)
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["MemoryLedger", "pytree_nbytes"]
+
+
+def pytree_nbytes(tree: Any) -> int:
+    """Total bytes of every array leaf in ``tree`` — metadata only
+    (``.nbytes`` never syncs), measured from the actual arrays."""
+    try:
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(tree)
+    except Exception:                      # jax-free caller: walk manually
+        leaves = []
+        stack = [tree]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, dict):
+                stack.extend(node.values())
+            elif isinstance(node, (list, tuple)):
+                stack.extend(node)
+            else:
+                leaves.append(node)
+    return sum(int(leaf.nbytes) for leaf in leaves
+               if hasattr(leaf, "nbytes"))
+
+
+def _escape_label(value: str) -> str:
+    return str(value).replace("\\", "\\\\").replace('"', '\\"')
+
+
+class MemoryLedger:
+    """Byte-exact component ledger with drift + pressure detection.
+
+    ``emit`` is the event sink — ``emit(kind, **fields)`` — so the engine
+    can inject its replica-labeling wrapper and the trainer its metrics
+    sink; defaults to the global metrics singleton. ``source`` labels
+    which tier's ledger emitted a row ("engine"/"trainer"/...), which is
+    how the trace renderer picks the process track."""
+
+    def __init__(self, *, emit: Optional[Callable[..., None]] = None,
+                 source: str = "engine",
+                 capacity_bytes: Optional[int] = None,
+                 auto_capacity: bool = True,
+                 pressure_frac: float = 0.92,
+                 device_drift_frac: float = 0.10,
+                 device_drift_min_bytes: int = 64 << 20,
+                 growth_streak: int = 12,
+                 poll_device: bool = True,
+                 device_stats_fn: Callable[[], Dict[str, int]] =
+                 device_memory_stats,
+                 rss_fn: Callable[[], Optional[int]] = host_rss_bytes):
+        if emit is None:
+            from building_llm_from_scratch_tpu.obs.metrics import emit_event
+
+            emit = emit_event
+        self._emit = emit
+        self.source = source
+        if capacity_bytes is None and auto_capacity:
+            from building_llm_from_scratch_tpu.obs.compile import (
+                device_hbm_capacity,
+            )
+
+            capacity_bytes = device_hbm_capacity()
+        self.capacity_bytes = capacity_bytes
+        self.pressure_frac = float(pressure_frac)
+        self.device_drift_frac = float(device_drift_frac)
+        self.device_drift_min_bytes = int(device_drift_min_bytes)
+        self.growth_streak = int(growth_streak)
+        self._poll_device = bool(poll_device)
+        self._device_stats_fn = device_stats_fn
+        self._rss_fn = rss_fn
+
+        # name -> (provider, device?)   providers return host int bytes
+        self._components: Dict[str, Tuple[Callable[[], int], bool]] = {}
+        self._expected: Dict[str, Callable[[], int]] = {}
+        # series -> (label key, provider returning {label value: bytes})
+        self._labeled: Dict[str, Tuple[str, Callable[[], Dict[str, int]]]] \
+            = {}
+        self._probes: Dict[str, Callable[[], Optional[Dict[str, Any]]]] = {}
+
+        self.sizes: Dict[str, int] = {}
+        self.watermarks: Dict[str, int] = {}
+        self.labeled_sizes: Dict[str, Dict[str, int]] = {}
+        self.labeled_peaks: Dict[str, Dict[str, int]] = {}
+        self._growth_last: Dict[str, int] = {}
+        self._growth_streaks: Dict[str, int] = {}
+        self._growth_fired: Dict[str, bool] = {}
+        self._pressure_armed = True
+        self.n_snapshots = 0
+        self.n_drift_events = 0
+        self.n_pressure_events = 0
+        self.device_stats: Dict[str, int] = {}
+        self.host_rss: Optional[int] = None
+
+    # -- registration -----------------------------------------------------
+
+    def register(self, name: str, provider: Callable[[], int], *,
+                 device: bool = True,
+                 expected: Optional[Callable[[], int]] = None) -> None:
+        """Register component ``name``. ``provider()`` -> bytes, measured
+        from live arrays (``pytree_nbytes``-style). ``expected`` is the
+        optional byte-exact expectation (e.g. ``bytes_per_slot x n_slots``
+        for the slot cache) — ANY mismatch is a ``memory_drift``."""
+        self._components[name] = (provider, bool(device))
+        if expected is not None:
+            self._expected[name] = expected
+
+    def register_labeled(self, series: str, label: str,
+                         provider: Callable[[], Dict[str, int]]) -> None:
+        """Register an attribution series (``series{label="..."}``) —
+        per-tenant live KV, per-namespace prefix bytes, etc. High
+        watermarks are tracked per label value."""
+        self._labeled[series] = (label, provider)
+
+    def register_probe(self, name: str,
+                       probe: Callable[[], Optional[Dict[str, Any]]]) \
+            -> None:
+        """Register an invariant probe run each ``observe``. A non-None
+        return is a violation: ``memory_drift`` fires with
+        ``component=name`` and the probe's dict merged into the event
+        (the probe supplies ``reason``, default "invariant")."""
+        self._probes[name] = probe
+
+    def track_host_rss(self) -> None:
+        """Track host RSS as a (non-device) ledger component, so host
+        growth (e.g. checkpoint staging buffers) is attributed instead
+        of being mystery growth next to the device numbers."""
+        def _rss() -> int:
+            v = self._rss_fn()
+            return 0 if v is None else v
+
+        self.register("host_rss", _rss, device=False)
+
+    # -- measurement ------------------------------------------------------
+
+    # graft: hot-path
+    def snapshot(self) -> Dict[str, int]:
+        """Refresh every component from its provider; update watermarks.
+        Pure metadata math — no events, no device polls, no syncs."""
+        for name, (provider, _device) in self._components.items():
+            size = int(provider())   # graft-ok: GL011 providers return host ints
+            self.sizes[name] = size
+            if size > self.watermarks.get(name, -1):
+                self.watermarks[name] = size
+        for series, (_label, provider) in self._labeled.items():
+            sizes = {str(k): int(v)   # graft-ok: GL011 host attribution dict
+                     for k, v in provider().items()}
+            self.labeled_sizes[series] = sizes
+            peaks = self.labeled_peaks.setdefault(series, {})
+            for key, size in sizes.items():
+                if size > peaks.get(key, -1):
+                    peaks[key] = size
+        return dict(self.sizes)
+
+    def device_bytes(self) -> int:
+        return sum(size for name, size in self.sizes.items()
+                   if self._components[name][1])
+
+    def host_bytes(self) -> int:
+        return sum(size for name, size in self.sizes.items()
+                   if not self._components[name][1])
+
+    def total_bytes(self) -> int:
+        return sum(self.sizes.values())
+
+    def headroom_bytes(self) -> Optional[int]:
+        """capacity − device components; None where capacity is unknown
+        (CPU backends report no ``bytes_limit``) — n/a-safe by absence."""
+        if self.capacity_bytes is None:
+            return None
+        return self.capacity_bytes - self.device_bytes()
+
+    # -- cadence ----------------------------------------------------------
+
+    # graft: hot-path
+    def observe(self, step: Optional[int] = None) -> Dict[str, int]:
+        """The cadence entry point: snapshot, run every detector, emit
+        ``memory_snapshot`` (+ ``memory_drift``/``memory_pressure`` as
+        needed). The snapshot event carries ONLY deterministic ``nbytes``
+        values — polled device/RSS numbers stay out of it so the trace
+        counter tracks are byte-identical across identical runs."""
+        comps = self.snapshot()
+        self.n_snapshots += 1
+        self._check_expected()
+        self._check_growth()
+        self._check_probes()
+        if self._poll_device:
+            self._poll()
+            self._check_device_divergence()
+        self._check_pressure(step)
+        fields: Dict[str, Any] = {
+            "source": self.source,
+            "components": comps,
+            "total_bytes": self.total_bytes(),
+            "device_bytes": self.device_bytes(),
+        }
+        host = self.host_bytes()
+        if host:
+            fields["host_bytes"] = host
+        if self.capacity_bytes is not None:
+            fields["capacity_bytes"] = self.capacity_bytes
+            fields["headroom_bytes"] = self.headroom_bytes()
+        if self.labeled_sizes:
+            fields["labeled"] = {series: dict(sizes) for series, sizes
+                                 in self.labeled_sizes.items() if sizes}
+        if step is not None:
+            fields["step"] = step
+        self._emit("memory_snapshot", **fields)
+        return comps
+
+    def _poll(self) -> None:
+        try:
+            self.device_stats = self._device_stats_fn() or {}
+        except Exception:                            # platform quirk: skip
+            self.device_stats = {}
+        try:
+            self.host_rss = self._rss_fn()
+        except Exception:
+            self.host_rss = None
+
+    # -- detectors --------------------------------------------------------
+
+    def _drift(self, component: str, reason: str, **fields: Any) -> None:
+        self.n_drift_events += 1
+        self._emit("memory_drift", component=component, reason=reason,
+                   source=self.source, **fields)
+        logger.warning("memory_drift[%s]: %s %s", component, reason,
+                       fields)
+
+    def _check_expected(self) -> None:
+        for name, expected_fn in self._expected.items():
+            expected = int(expected_fn())  # graft-ok: GL011 host int math
+            measured = self.sizes.get(name, 0)
+            if measured != expected:
+                self._drift(name, "reconcile", expected_bytes=expected,
+                            measured_bytes=measured,
+                            delta_bytes=measured - expected)
+
+    def _check_growth(self) -> None:
+        """A component that grows on EVERY snapshot for ``growth_streak``
+        consecutive windows is leaking (healthy components plateau or
+        shrink under eviction). Fires once per streak; re-arms when the
+        component stops growing."""
+        for name, size in self.sizes.items():
+            prev = self._growth_last.get(name)
+            self._growth_last[name] = size
+            if prev is None:
+                continue
+            if size > prev:
+                streaks = self._growth_streaks
+                streaks[name] = streaks.get(name, 0) + 1
+                if (streaks[name] >= self.growth_streak
+                        and not self._growth_fired.get(name)):
+                    self._growth_fired[name] = True
+                    self._drift(name, "monotonic_growth",
+                                streak=streaks[name],
+                                measured_bytes=size)
+            else:
+                self._growth_streaks.pop(name, None)
+                self._growth_fired.pop(name, None)
+
+    def _check_probes(self) -> None:
+        for name, probe in self._probes.items():
+            try:
+                violation = probe()
+            except Exception:
+                logger.exception("memory probe %s raised", name)
+                continue
+            if violation:
+                fields = dict(violation)
+                reason = fields.pop("reason", "invariant")
+                self._drift(name, reason, **fields)
+
+    def _check_device_divergence(self) -> None:
+        """Ledger vs the runtime's own accounting, where the platform
+        reports it (TPU/GPU; CPU returns {} and this is a no-op). Large
+        untracked usage = something allocating outside the ledger."""
+        in_use = self.device_stats.get("bytes_in_use")
+        if in_use is None:
+            return
+        ledger = self.device_bytes()
+        delta = in_use - ledger
+        threshold = max(self.device_drift_min_bytes,
+                        int(self.device_drift_frac * max(in_use, ledger)))
+        if abs(delta) > threshold:
+            self._drift("device", "device_divergence",
+                        device_bytes=in_use, ledger_bytes=ledger,
+                        delta_bytes=delta)
+
+    def _check_pressure(self, step: Optional[int]) -> None:
+        """Headroom watch with the flight-recorder dump: on the upward
+        crossing of ``pressure_frac`` the FULL breakdown rides the event
+        — the post-mortem should never need a second run to learn what
+        was resident. Hysteresis: re-arms when usage falls back under."""
+        if self.capacity_bytes is None or self.capacity_bytes <= 0:
+            return
+        used = self.device_bytes()
+        frac = used / self.capacity_bytes
+        if frac >= self.pressure_frac:
+            if self._pressure_armed:
+                self._pressure_armed = False
+                self.n_pressure_events += 1
+                fields: Dict[str, Any] = {
+                    "source": self.source,
+                    "headroom_bytes": self.capacity_bytes - used,
+                    "capacity_bytes": self.capacity_bytes,
+                    "used_frac": round(frac, 6),
+                    "threshold_frac": self.pressure_frac,
+                    "device_bytes": used,
+                    "total_bytes": self.total_bytes(),
+                    "components": {
+                        name: size for name, size in self.sizes.items()
+                        if self._components[name][1]},
+                }
+                if self.labeled_sizes:
+                    fields["labeled"] = {
+                        series: dict(sizes) for series, sizes
+                        in self.labeled_sizes.items() if sizes}
+                if step is not None:
+                    fields["step"] = step
+                self._emit("memory_pressure", **fields)
+                logger.warning(
+                    "memory_pressure: %.1f%% of %d bytes used "
+                    "(headroom %d)", 100 * frac, self.capacity_bytes,
+                    self.capacity_bytes - used)
+        else:
+            self._pressure_armed = True
+
+    # -- export -----------------------------------------------------------
+
+    # graft: hot-path
+    def gauges(self) -> Dict[str, Any]:
+        """Metric-ready gauges for a ``metrics_snapshot()`` merge: one
+        labeled series per component (+ its high watermark), totals,
+        headroom, the attribution series, and the last polled device/RSS
+        numbers. Everything here is host state — safe under the scrape
+        path's timed lock."""
+        out: Dict[str, Any] = {}
+        for name, size in self.sizes.items():
+            lbl = f'{{component="{_escape_label(name)}"}}'
+            out[f"mem_component_bytes{lbl}"] = size
+            out[f"mem_component_peak_bytes{lbl}"] = self.watermarks[name]
+        out["mem_total_bytes"] = self.total_bytes()
+        out["mem_device_bytes"] = self.device_bytes()
+        host = self.host_bytes()
+        if host:
+            out["mem_host_bytes"] = host
+        if self.capacity_bytes is not None:
+            out["mem_capacity_bytes"] = self.capacity_bytes
+            out["mem_headroom_bytes"] = self.headroom_bytes()
+        out["mem_drift_events"] = self.n_drift_events
+        out["mem_pressure_events"] = self.n_pressure_events
+        for series, (label, _provider) in self._labeled.items():
+            sizes = self.labeled_sizes.get(series, {})
+            peaks = self.labeled_peaks.get(series, {})
+            for key in sorted(set(sizes) | set(peaks)):
+                lbl = f'{{{label}="{_escape_label(key)}"}}'
+                if key in sizes:
+                    out[f"{series}{lbl}"] = sizes[key]
+                if key in peaks:
+                    out[f"{series}_peak{lbl}"] = peaks[key]
+        for stats_key, gauge in (("bytes_in_use", "hbm_bytes_in_use"),
+                                 ("peak_bytes_in_use", "hbm_peak_bytes")):
+            if stats_key in self.device_stats:
+                out[gauge] = self.device_stats[stats_key]
+        if self.host_rss is not None:
+            out["host_rss_bytes"] = self.host_rss
+        return out
+
+    def legacy_row(self) -> Dict[str, int]:
+        """The trainer's historical metrics-row keys, now sourced from
+        the ledger's single poll (Satellite: the ad-hoc gauges dedupe
+        onto the ledger; renderers keep working unchanged)."""
+        out: Dict[str, int] = {}
+        if "bytes_in_use" in self.device_stats:
+            out["hbm_bytes_in_use"] = self.device_stats["bytes_in_use"]
+        if "peak_bytes_in_use" in self.device_stats:
+            out["hbm_peak_bytes"] = self.device_stats["peak_bytes_in_use"]
+        if self.host_rss is not None:
+            out["host_rss_bytes"] = self.host_rss
+        return out
+
+    def describe(self) -> Dict[str, Any]:
+        """Host-side summary for ``stats()``-style surfaces."""
+        out: Dict[str, Any] = {
+            "components": dict(self.sizes),
+            "watermarks": dict(self.watermarks),
+            "total_bytes": self.total_bytes(),
+            "device_bytes": self.device_bytes(),
+            "n_snapshots": self.n_snapshots,
+            "n_drift_events": self.n_drift_events,
+            "n_pressure_events": self.n_pressure_events,
+        }
+        if self.capacity_bytes is not None:
+            out["capacity_bytes"] = self.capacity_bytes
+            out["headroom_bytes"] = self.headroom_bytes()
+        return out

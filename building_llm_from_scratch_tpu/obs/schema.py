@@ -35,11 +35,17 @@ from typing import Any, Dict, FrozenSet, List
 
 #: Bump when a row type or a load-bearing field changes meaning. The
 #: ``header`` row carries it; consumers key parsing decisions on it.
-SCHEMA_VERSION = 10         # v10: fleet observatory — clock_sync /
+SCHEMA_VERSION = 11         # v11: memory observatory — memory_snapshot /
+                            # memory_pressure / memory_drift events
+                            # (obs/memory.py MemoryLedger: byte-exact
+                            # component ledger + drift/pressure
+                            # detection), request_done gains
+                            # kv_bytes_peak + prefix_bytes_saved
+                            # (v10: fleet observatory — clock_sync /
                             # incident_snapshot events, worker_request +
                             # rpc span roots (worker-side trees stamped
                             # with pid/incarnation), worker_* events
-                            # rendered on the incidents trace track
+                            # rendered on the incidents trace track)
                             # (v9: cross-process fleet — worker_spawn /
                             # worker_heartbeat_missed / worker_dead /
                             # worker_restart / pane_handoff events
@@ -74,11 +80,16 @@ TRAIN_SEGMENTS = ("data_wait", "dispatch", "host_fetch", "eval", "sample",
 #: The worker-process lifecycle kinds joined in v10 so the fleet
 #: exporter (obs/fleetview.py) and the single-file exporter render the
 #: same death/restart instants without a second table.
+#: ``memory_pressure``/``memory_drift`` joined in v11: a near-OOM
+#: crossing or a ledger leak is an incident the timeline must show next
+#: to the tick phases (``memory_snapshot`` is NOT here — it renders as a
+#: counter track, not an instant).
 INCIDENT_EVENTS = ("engine_restart", "drain", "serve_error", "stall",
                    "watchdog_halt", "preemption_signal", "preemption_stop",
                    "checkpoint_fallback", "serve_warmup",
                    "worker_spawn", "worker_heartbeat_missed", "worker_dead",
-                   "worker_restart", "pane_handoff", "incident_snapshot")
+                   "worker_restart", "pane_handoff", "incident_snapshot",
+                   "memory_pressure", "memory_drift")
 
 #: Request-lifecycle event kinds pinned to the request's own trace track.
 REQUEST_EVENTS = ("request_done", "request_rejected", "request_shed",
@@ -202,10 +213,13 @@ _EVENT_LIST: List[EventSpec] = [
           optional=("n_prompt_tokens", "n_tokens", "finish_reason", "slot",
                     "deadline_s", "queue_wait_s", "ttft_s", "tpot_s",
                     "e2e_s", "adapter", "spec_drafted", "spec_accepted",
-                    "replica"),
+                    "kv_bytes_peak", "prefix_bytes_saved", "replica"),
           doc="one request completed normally (latency summary; "
               "spec_drafted/spec_accepted = this request's speculative "
-              "acceptance ledger on --serve_spec_k engines)"),
+              "acceptance ledger on --serve_spec_k engines; "
+              "kv_bytes_peak = the slot KV bytes the request occupied at "
+              "its longest; prefix_bytes_saved = KV bytes prefix-cache "
+              "hits spared it from recomputing)"),
     _spec("request_rejected", required=("request_id", "reason"),
           optional=("queue_depth", "replica"),
           doc="bounded queue at capacity at submit (HTTP 429)"),
@@ -368,6 +382,35 @@ _EVENT_LIST: List[EventSpec] = [
           optional=("timeout_s", "n_active", "queue_depth", "n_preempted",
                     "seconds", "requests_finished", "replica"),
           doc="graceful drain bracketing events (phase: start|end)"),
+    # -- memory observatory (obs/memory.py) --------------------------------
+    _spec("memory_snapshot", required=("source", "components"),
+          optional=("total_bytes", "device_bytes", "host_bytes",
+                    "capacity_bytes", "headroom_bytes", "labeled",
+                    "replica"),
+          doc="one MemoryLedger cadence snapshot: component -> bytes, "
+              "measured from the live pytrees (nbytes sums — "
+              "deterministic, so the trace's memory counter tracks are "
+              "byte-identical across identical runs). labeled = the "
+              "attribution series (per-tenant live KV, per-namespace "
+              "prefix bytes, per-tenant adapter rows)"),
+    _spec("memory_drift", required=("component", "reason"),
+          optional=("expected_bytes", "measured_bytes", "delta_bytes",
+                    "streak", "pinned_bytes", "pinned_entries",
+                    "device_bytes", "ledger_bytes", "source", "replica"),
+          doc="the leak detector fired: a component diverged from its "
+              "byte-exact expectation (reason: reconcile), only ever "
+              "grows (monotonic_growth), violated a probe invariant "
+              "(e.g. pinned_orphan — a prefix pane still pinned at a "
+              "cadence boundary), or the ledger diverged from "
+              "device.memory_stats() (device_divergence)"),
+    _spec("memory_pressure", required=("headroom_bytes", "capacity_bytes"),
+          optional=("used_frac", "threshold_frac", "device_bytes",
+                    "total_bytes", "components", "labeled", "source",
+                    "replica"),
+          doc="near-OOM flight recorder: device components crossed "
+              "pressure_frac of capacity — the event carries the FULL "
+              "component breakdown so the post-mortem has the "
+              "composition at the moment headroom vanished"),
 ]
 
 #: kind -> EventSpec. The single source of truth the GL04x lint, the

@@ -96,6 +96,30 @@ def _num(row: dict, key: str) -> Optional[float]:
     return float(v) if isinstance(v, (int, float)) else None
 
 
+def _memory_counters(row: dict, pid: int, ts_us: float) -> List[dict]:
+    """One ``memory_snapshot`` event -> Perfetto counter samples: the
+    component composition as ONE stacked counter track (Perfetto stacks
+    the args keys), plus a headroom track when capacity is known. The
+    values are the ledger's deterministic ``nbytes`` sums — identical
+    runs produce byte-identical tracks (keys sorted so the rendering
+    never depends on emission order). ``host_rss`` is the one POLLED
+    component (OS-dependent, run-to-run noise) and is host memory
+    besides — it stays off the device-composition track."""
+    events: List[dict] = []
+    comps = row.get("components")
+    if isinstance(comps, dict):
+        values = {k: comps[k] for k in sorted(comps)
+                  if k != "host_rss"
+                  and isinstance(comps[k], (int, float))}
+        if values:
+            events.append(_counter("memory (bytes)", pid, ts_us, values))
+    headroom = row.get("headroom_bytes")
+    if isinstance(headroom, (int, float)):
+        events.append(_counter("memory headroom (bytes)", pid, ts_us,
+                               {"headroom": headroom}))
+    return events
+
+
 def load_jsonl(path: str) -> List[dict]:
     rows = []
     with open(path) as f:
@@ -243,6 +267,12 @@ def chrome_trace(rows: List[dict],
                                  _PID_INCIDENTS, 2,
                                  ts_us - dur * 1e6, dur * 1e6,
                                  "compile", args))
+            elif name == "memory_snapshot":
+                # memory composition over time, next to the tick/step
+                # phases of whichever tier emitted it
+                events += _memory_counters(
+                    row, _PID_TRAIN if row.get("source") == "trainer"
+                    else _PID_ENGINE, ts_us)
             elif name in REQUEST_EVENTS and isinstance(
                     row.get("request_id"), int):
                 events.append(_instant(name, _PID_REQUESTS,

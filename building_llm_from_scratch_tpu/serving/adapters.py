@@ -370,6 +370,23 @@ class AdapterRegistry:
 
     # -- introspection -----------------------------------------------------
 
+    def pool_nbytes(self) -> int:
+        """Total device bytes of the stacked pool (+ scaling vector),
+        measured from the live arrays — the memory ledger's
+        ``adapter_pool`` component. Metadata only: never syncs."""
+        from building_llm_from_scratch_tpu.obs.memory import pytree_nbytes
+
+        pool, scaling = self._device
+        return pytree_nbytes(pool) + int(scaling.nbytes)
+
+    def bytes_by_adapter(self) -> Dict[str, int]:
+        """Per-tenant attribution: each loaded adapter owns 1/capacity
+        of the (fixed-shape, zero-padded) pool. Unloaded rows are the
+        pool's standing headroom and stay unattributed — the component
+        total still reports them."""
+        per_row = self.pool_nbytes() // max(self.capacity, 1)
+        return {name: per_row for name in self._by_name}
+
     def stats(self) -> dict:
         with self._lock:
             return {

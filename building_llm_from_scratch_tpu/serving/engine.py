@@ -69,6 +69,10 @@ from building_llm_from_scratch_tpu.models.transformer import (
     verify_slots,
 )
 from building_llm_from_scratch_tpu.obs.compile import CompileWatcher
+from building_llm_from_scratch_tpu.obs.memory import (
+    MemoryLedger,
+    pytree_nbytes,
+)
 from building_llm_from_scratch_tpu.obs.metrics import (
     Histogram,
     RollingRatio,
@@ -328,6 +332,14 @@ class DecodeEngine:
             self._decode = step_watched
             self._verify = None
 
+        #: memory observatory (obs/memory.py): the per-token KV cost the
+        #: live-attribution math scales host lengths by, and the ledger
+        #: itself — built AFTER the cache/store/pool exist so every
+        #: provider closes over live engine state
+        self._kv_bytes_per_token = self.kv_policy.bytes_per_slot(
+            self.cfg, self._cache_len)["bytes_per_token"]
+        self.memory_ledger = self._build_memory_ledger()
+
         self._lock = threading.RLock()
         self._work = threading.Condition()
         self._thread: Optional[threading.Thread] = None
@@ -440,6 +452,104 @@ class DecodeEngine:
         if self.replica is not None:
             fields["replica"] = self.replica
         get_metrics().event(kind, **fields)
+
+    # -- memory observatory (obs/memory.py) --------------------------------
+
+    def _build_memory_ledger(self) -> MemoryLedger:
+        """Register every device-memory consumer this engine owns as a
+        ledger component, measured from the LIVE arrays (providers close
+        over ``self`` — a donated-cache rebind or a restart's fresh
+        allocation is picked up on the next snapshot automatically).
+        Expectations are the byte-exact analytic sizes, so any
+        measured-vs-expected gap is a ``memory_drift``."""
+        ledger = MemoryLedger(emit=self._ev, source="engine")
+        ledger.register("model_params",
+                        lambda: pytree_nbytes(self.params))
+        bps = self.kv_policy.bytes_per_slot(self.cfg, self.max_len)
+        n = self.n_slots
+        ledger.register("slot_kv",
+                        lambda: self._cache_component_bytes()[0],
+                        expected=lambda: bps["kv_bytes"] * n)
+        if bps["scale_bytes"]:
+            ledger.register("kv_scales",
+                            lambda: self._cache_component_bytes()[1],
+                            expected=lambda: bps["scale_bytes"] * n)
+        if self.spec_k:
+            bps_full = self.kv_policy.bytes_per_slot(self.cfg,
+                                                     self._cache_len)
+            ledger.register(
+                "spec_headroom",
+                lambda: self._cache_component_bytes()[2],
+                expected=lambda: (bps_full["total_bytes"]
+                                  - bps["total_bytes"]) * n)
+        if self.prefix_store is not None:
+            store = self.prefix_store
+            ledger.register("prefix_store", lambda: store.bytes_total)
+            ledger.register_labeled("prefix_store_bytes", "namespace",
+                                    store.bytes_by_tag)
+            ledger.register_probe("prefix_store",
+                                  self._prefix_pinned_probe)
+        if self.adapters is not None:
+            ledger.register("adapter_pool", self.adapters.pool_nbytes)
+            ledger.register_labeled("adapter_pool_bytes", "tenant",
+                                    self.adapters.bytes_by_adapter)
+        ledger.register("compile_temps", self._compile_temp_bytes)
+        ledger.register_labeled("kv_live_bytes", "tenant",
+                                self._kv_live_by_tenant)
+        ledger.track_host_rss()
+        return ledger
+
+    # called under _lock from the cadence observe and the scrape's timed
+    # acquire; a failed timed acquire reads stale-but-safe metadata,
+    # like the rest of metrics_snapshot
+    # graft: hot-path
+    def _cache_component_bytes(self) -> tuple:  # holds: _lock
+        """(slot_kv, kv_scales, spec_headroom) bytes of the live slot
+        cache, measured from the actual arrays' ``nbytes`` (metadata —
+        never a sync). The spec headroom tail (``spec_k`` positions past
+        ``max_len``) is carved out along the time axis; the three parts
+        sum to ``cache_nbytes(self.cache)`` byte-exactly because every
+        array's byte count is divisible by its time extent."""
+        kv_nb = sum(a.nbytes for key in ("k", "v")
+                    for a in self.cache.get(key, ()))
+        scale_nb = sum(a.nbytes for key in ("k_scale", "v_scale")
+                       for a in self.cache.get(key, ()))
+        slot_kv = kv_nb * self.max_len // self._cache_len
+        kv_scales = scale_nb * self.max_len // self._cache_len
+        return slot_kv, kv_scales, kv_nb + scale_nb - slot_kv - kv_scales
+
+    def _compile_temp_bytes(self) -> int:
+        """Peak compile-time scratch across the engine's programs (HLO
+        memory analysis via CompileWatcher): programs execute one at a
+        time, so the RESIDENT scratch is the max, not the sum."""
+        peak = 0
+        for w in self._watchers():
+            mem = getattr(w, "memory", None) or {}
+            peak = max(peak, mem.get("temp_bytes", 0))
+        return peak
+
+    # graft: hot-path
+    def _kv_live_by_tenant(self) -> dict:  # holds: _lock
+        """Live KV attribution: each occupied slot's committed length x
+        bytes/token, rolled up by tenant (adapter name; "base" for
+        un-adapted traffic). Host numpy state only."""
+        out: dict = {}
+        for slot, req in self.scheduler.active():
+            nm = req.params.adapter or BASE_ADAPTER
+            live = int(self._lengths[slot])  # graft-ok: GL011 host numpy
+            out[nm] = out.get(nm, 0) + live * self._kv_bytes_per_token
+        return out
+
+    def _prefix_pinned_probe(self) -> Optional[dict]:
+        """Pins are held only across one in-flight pane copy under the
+        engine lock — an entry still pinned when the cadence observes is
+        leaked (its bytes can never be evicted). The ledger turns a
+        non-None return into ``memory_drift(component="prefix_store")``."""
+        pinned, keys = self.prefix_store.pinned_bytes()
+        if not pinned:
+            return None
+        return {"reason": "pinned_orphan", "pinned_bytes": pinned,
+                "pinned_entries": keys[:8], "measured_bytes": pinned}
 
     # -- jitted programs (close over params/cfg/blocks so per-tick call
     # signatures carry only the small mutable state + caches) -------------
@@ -1061,11 +1171,15 @@ class DecodeEngine:
 
     # holds: _lock
     def _apply_prefix_hit(self, slot: int, req: Request, gen: int,
-                          span: int, entry, late: bool) -> bool:
+                          span: int, entry, late: bool,
+                          prev_pos: int = 0) -> bool:
         """Copy a matched (pinned) entry's panes into ``slot`` and emit
         the hit. Returns False on a generation abort (nothing committed).
         ``late``: the catch-up hit — a mid-prefill slot jumping ahead on
-        a pane a co-resident sharer just stored (see ``_chunk_tick``)."""
+        a pane a co-resident sharer just stored (see ``_chunk_tick``);
+        ``prev_pos`` is the slot's already-prefilled position then, so
+        the request's ``prefix_bytes_saved`` ledger counts only the NEW
+        tokens the copy spared it from recomputing."""
         t_cp = time.perf_counter()
         try:
             cache = self._prefix_copy(self.cache, entry.panes,
@@ -1077,6 +1191,10 @@ class DecodeEngine:
         self.cache = cache
         self._window_prefix_hits += 1
         self._tick_add("prefix_copy", time.perf_counter() - t_cp)
+        # the exact quantity ROADMAP item 1 (paged KV) optimizes: KV
+        # bytes this hit spared the request from recomputing
+        req.prefix_bytes_saved += ((span - prev_pos)
+                                   * self._kv_bytes_per_token)
         Tp = int(req.prompt_ids.size)   # graft-ok: GL011 host numpy size
         self._ev(
             "prefix_hit", request_id=req.id, span_tokens=span,
@@ -1115,7 +1233,8 @@ class DecodeEngine:
                     min_span=st["pos"], count_miss=False)
                 if entry is not None:
                     if not self._apply_prefix_hit(slot, req, gen, span,
-                                                  entry, late=True):
+                                                  entry, late=True,
+                                                  prev_pos=st["pos"]):
                         return False
                     st["pos"] = span
                     self._lengths[slot] = span
@@ -1642,6 +1761,11 @@ class DecodeEngine:
         req.finish_reason = reason
         req.t_finish = time.monotonic()
         if self.scheduler.slots[slot] is req:  # not reassigned by restart
+            # peak slot-KV attribution, read BEFORE the slot is freed:
+            # lengths only grow over a request's residency, so the final
+            # committed length IS the peak
+            live = int(self._lengths[slot])  # graft-ok: GL011 host numpy
+            req.kv_bytes_peak = live * self._kv_bytes_per_token
             self._free_slot(slot)
         self.requests_finished += 1
         self._count_adapter(req, "finished")
@@ -1717,6 +1841,11 @@ class DecodeEngine:
         self._window_spec_accepted = 0
         self._tick_acc = {ph: 0.0 for ph in TICK_PHASES}
         self._tick_acc_total = 0.0
+        # memory-ledger cadence: snapshot + drift/pressure detectors +
+        # the memory_snapshot event the trace renders as counter tracks.
+        # Pure nbytes/host math — the tick's device syncs stay the two
+        # the decode loop always had (guard-tested)
+        self.memory_ledger.observe(self.n_ticks)
 
     # -- warmup / compile discipline --------------------------------------
 
@@ -2187,6 +2316,7 @@ class DecodeEngine:
             if self.adapters is not None:
                 out["adapters_loaded"] = self.adapters.n_loaded
             out["kv_policy"] = self.kv_policy.describe()
+            out["memory"] = self.memory_ledger.describe()
             if self.prefix_store is not None:
                 out["prefix_store"] = self.prefix_store.stats()
             slo = self.slo_window.ratio()
@@ -2293,6 +2423,12 @@ class DecodeEngine:
                                               if ratio is not None else 0.0)
                 gauges["prefix_entries"] = self.prefix_store.n_entries
                 gauges["prefix_bytes"] = self.prefix_store.bytes_total
+            # memory observatory: refresh the ledger from the live
+            # arrays (metadata math — safe under the timed lock) and
+            # export the component/watermark/attribution series; the
+            # fleet scrape path relabels these per worker automatically
+            self.memory_ledger.snapshot()
+            gauges.update(self.memory_ledger.gauges())
             # always exported: a scrape gap (series absent until the
             # first deadline-carrying request) reads as "no data" on a
             # dashboard when the truth is "no misses"

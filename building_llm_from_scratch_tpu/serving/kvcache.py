@@ -58,7 +58,7 @@ import threading
 import time
 from collections import OrderedDict
 from dataclasses import dataclass
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -252,9 +252,10 @@ def extract_prefix_panes(cache: Params, slot, n_valid, *,
 
 class _Entry:
     __slots__ = ("key", "panes", "span", "nbytes", "pins", "hits",
-                 "t_insert")
+                 "t_insert", "tag")
 
-    def __init__(self, key: str, panes: Params, span: int, nbytes: int):
+    def __init__(self, key: str, panes: Params, span: int, nbytes: int,
+                 tag: Optional[str] = None):
         self.key = key
         self.panes = panes
         self.span = span
@@ -262,6 +263,10 @@ class _Entry:
         self.pins = 0
         self.hits = 0
         self.t_insert = time.monotonic()
+        # namespace tag (adapter identity) for per-tenant byte
+        # attribution; None for raw-key imports (the donor's tag is
+        # hashed into the key but not transported)
+        self.tag = tag
 
 
 class PrefixStore:
@@ -369,7 +374,7 @@ class PrefixStore:
         entry alone exceeds the budget or everything evictable is
         pinned (also 0, uncounted, when the key is already stored)."""
         return self._insert_keyed(self.key(token_ids, tag), panes,
-                                  len(token_ids))
+                                  len(token_ids), tag=tag)
 
     def import_entry(self, key: str, panes: Params, span: int) -> int:
         """Raw-key insert for cross-process pane handoff (fleet drain).
@@ -390,7 +395,8 @@ class PrefixStore:
             return [(e.key, e.span, e.panes)
                     for e in self._entries.values()]
 
-    def _insert_keyed(self, k: str, panes: Params, span: int) -> int:
+    def _insert_keyed(self, k: str, panes: Params, span: int,
+                      tag: Optional[str] = None) -> int:
         nbytes = cache_nbytes(panes)
         evicted = []
         with self._lock:
@@ -411,7 +417,7 @@ class PrefixStore:
                 self.bytes_total -= victim.nbytes
                 self.n_evictions += 1
                 evicted.append(victim)
-            entry = _Entry(k, panes, span, nbytes)
+            entry = _Entry(k, panes, span, nbytes, tag=tag)
             self._entries[k] = entry
             self.bytes_total += nbytes
             self.n_inserts += 1
@@ -439,6 +445,28 @@ class PrefixStore:
             hits, misses = self.n_hits, self.n_misses
         n = hits + misses
         return (hits / n) if n else None
+
+    def bytes_by_tag(self) -> Dict[str, int]:
+        """Per-namespace byte attribution for the memory ledger: tag ->
+        total pane bytes ("external" for raw-key imports, whose donor
+        tag is hashed into the key but not transported)."""
+        out: Dict[str, int] = {}
+        with self._lock:
+            for e in self._entries.values():
+                tag = e.tag if e.tag is not None else "external"
+                out[tag] = out.get(tag, 0) + e.nbytes
+        return out
+
+    def pinned_bytes(self) -> Tuple[int, List[str]]:
+        """(bytes, keys) of currently pinned entries. Pins are transient
+        by design — held only across one in-flight pane copy under the
+        engine lock — so anything still pinned at a cadence boundary is
+        an orphan: the memory ledger's ``pinned_orphan`` probe turns a
+        non-empty answer into a ``memory_drift`` event."""
+        with self._lock:
+            pinned = [(e.key, e.nbytes) for e in self._entries.values()
+                      if e.pins > 0]
+        return sum(nb for _k, nb in pinned), [k for k, _nb in pinned]
 
     def stats(self) -> dict:
         with self._lock:

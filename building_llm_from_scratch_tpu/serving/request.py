@@ -111,6 +111,11 @@ class Request:
         # decode tick; accepted = the in-graph accepted-draft count
         self.spec_drafted = 0
         self.spec_accepted = 0
+        # memory-ledger fields (obs/memory.py): peak slot-KV bytes this
+        # request occupied (set at retirement, before the slot is freed)
+        # and the KV bytes prefix-cache hits spared it from recomputing
+        self.kv_bytes_peak = 0
+        self.prefix_bytes_saved = 0
         # timestamps (time.monotonic): submit -> admit (queue wait) ->
         # first token (TTFT) -> finish (TPOT over the decode tail).
         # wall_submit anchors the monotonic timeline to unix time so the
@@ -216,6 +221,10 @@ class Request:
             # decode the drafter paid for
             out["spec_drafted"] = self.spec_drafted
             out["spec_accepted"] = self.spec_accepted
+        if self.kv_bytes_peak:
+            out["kv_bytes_peak"] = self.kv_bytes_peak
+        if self.prefix_bytes_saved:
+            out["prefix_bytes_saved"] = self.prefix_bytes_saved
         for name, fn in (("queue_wait_s", self.queue_wait_s),
                          ("ttft_s", self.ttft_s), ("tpot_s", self.tpot_s),
                          ("e2e_s", self.e2e_s)):

@@ -100,9 +100,9 @@ from building_llm_from_scratch_tpu.utils.io import (
     read_text_file,
 )
 from building_llm_from_scratch_tpu.utils.logging import setup_logger
-from building_llm_from_scratch_tpu.utils.memory import (
-    device_memory_stats,
-    host_rss_bytes,
+from building_llm_from_scratch_tpu.obs.memory import (
+    MemoryLedger,
+    pytree_nbytes,
 )
 
 logger = setup_logger(__name__)
@@ -228,6 +228,11 @@ class Trainer:
         self._pending_lrs: List[Any] = []
         self.track_tokens_seen: List[int] = []
         self.throughput_tokens_per_s: List[float] = []
+        # memory observatory (obs/memory.py): built lazily at the first
+        # metrics cadence (the train state must exist first); the
+        # trainer's former ad-hoc HBM/RSS gauges now read THROUGH it —
+        # one source of truth for every memory number the run reports
+        self._memory_ledger: Optional[MemoryLedger] = None
 
     @property
     def metrics_sink(self):
@@ -238,6 +243,31 @@ class Trainer:
         trail across two files. Always non-None: unconfigured use gets
         the no-op sink."""
         return get_metrics()
+
+    def _build_memory_ledger(self) -> MemoryLedger:
+        """The training tier's memory ledger: model params (trainable +
+        frozen), optimizer state, compile-time temps (HLO memory
+        analysis), host RSS — each measured from the LIVE pytrees
+        (``nbytes`` sums), with drift/pressure detection and the
+        ``memory_snapshot`` cadence event the trace renders as counter
+        tracks on the train process row."""
+        ledger = MemoryLedger(source="trainer")
+        ledger.register(
+            "model_params",
+            lambda: (pytree_nbytes(self.state["trainable"])
+                     + pytree_nbytes(self.state["frozen"])))
+        ledger.register(
+            "optimizer_state",
+            lambda: pytree_nbytes(self.state["opt_state"]))
+
+        def _temps() -> int:
+            w = self._compile_watcher
+            mem = (getattr(w, "memory", None) or {}) if w else {}
+            return mem.get("temp_bytes", 0)
+
+        ledger.register("compile_temps", _temps)
+        ledger.track_host_rss()
+        return ledger
 
     # ------------------------------------------------------------------
     # Setup
@@ -816,13 +846,14 @@ class Trainer:
                             # graft-ok: GL012 host bundle (see above)
                             np.asarray(self._last_health[key],
                                        np.float64) ** 2))), 8)
-                dev_mem = device_memory_stats()
-                if dev_mem:
-                    row["hbm_bytes_in_use"] = dev_mem.get("bytes_in_use")
-                    row["hbm_peak_bytes"] = dev_mem.get("peak_bytes_in_use")
-                rss = host_rss_bytes()
-                if rss is not None:
-                    row["host_rss_bytes"] = rss
+                # memory ledger cadence: byte-exact components from the
+                # live train state + the single device-stats/RSS poll
+                # (legacy_row keeps the historical hbm_*/host_rss_bytes
+                # row keys, so renderers and plots read unchanged)
+                if self._memory_ledger is None:
+                    self._memory_ledger = self._build_memory_ledger()
+                self._memory_ledger.observe(self.global_step)
+                row.update(self._memory_ledger.legacy_row())
                 if at_eval:
                     with self.timeline.span("eval"):
                         train_loss, val_loss = self.evaluate_model(

@@ -109,6 +109,20 @@ assert len(spans) == 8, f"expected one span tree per request: {len(spans)}"
 recompiles = [r for r in rows if r.get("event") == "recompile"]
 assert not recompiles, f"recompile after warmup: {recompiles}"
 assert engine.n_recompiles == 0
+# memory observatory: the ledger's slot-KV component (measured from the
+# live cache pytree) must equal the policy's byte-exact per-slot budget
+# x n_slots — the reconcile invariant obs/memory.py re-checks at every
+# cadence — and the cadence must have emitted snapshot rows with no
+# drift incident on a healthy run
+snap = engine.memory_ledger.snapshot()
+bps = engine.kv_policy.bytes_per_slot(engine.cfg, engine.max_len)
+slot_kv = snap["slot_kv"] + snap.get("kv_scales", 0)
+assert slot_kv == bps["total_bytes"] * 4, (snap, bps)
+mem_snaps = [r for r in rows if r.get("event") == "memory_snapshot"]
+assert len(mem_snaps) >= 1, "no memory_snapshot row at cadence"
+assert mem_snaps[-1]["components"]["slot_kv"] == snap["slot_kv"]
+drift = [r for r in rows if r.get("event") == "memory_drift"]
+assert not drift, f"spurious memory_drift on a healthy run: {drift}"
 # trace exporter round-trip on the smoke's JSONL: Perfetto-loadable
 # Chrome trace with per-request span trees AND tick windows
 from building_llm_from_scratch_tpu.obs.trace import export_chrome_trace
@@ -117,12 +131,23 @@ meta = export_chrome_trace(mj, trace_path)
 assert meta["n_request_spans"] == 8, meta
 assert meta["n_tick_windows"] >= 1, meta
 json.load(open(trace_path))               # valid JSON
+import shutil
+shutil.copy(mj, "/tmp/_ci_serve_metrics.jsonl")
 print(f"serving smoke ok: {len(results)} requests, "
       f"{sum(r['n_tokens'] for r in results)} tokens, "
-      f"{len(done)} request_done events, 0 recompiles, "
+      f"{len(done)} request_done events, {len(mem_snaps)} memory "
+      f"snapshots (slot_kv {slot_kv}B byte-exact), 0 recompiles, "
       f"{meta['n_request_spans']} trace spans, "
       f"{meta['n_tick_windows']} tick windows")
 EOF
+# renderer grows a memory-observatory section: composition table,
+# per-request KV peaks — assert it opens on the smoke's telemetry
+render_out=$(JAX_PLATFORMS=cpu python scripts/summarize_metrics.py \
+    /tmp/_ci_serve_metrics.jsonl --out /tmp/_ci_serve_metrics.png) \
+    || exit 1
+echo "$render_out" | grep -q -- "-- memory --" || exit 1
+echo "$render_out" | grep -q "request KV: peak" || exit 1
+echo "memory renderer ok"
 
 echo "== multi-tenant LoRA serving smoke (train-export -> serve, CPU) =="
 JAX_PLATFORMS=cpu python - <<'EOF' || exit 1

@@ -450,12 +450,37 @@ def summarize_fleet_files(paths, trace=None):
                      if e.get("replica") is not None else "")
                   + (f" ({extra})" if extra else ""))
     summarize_fleet_observability(fleet[3])
+    # merged memory view: one line per ledger (the router has none; each
+    # worker incarnation observes its own), then the fleet device total
+    mem_last = []                     # (tag, last snapshot, n snapshots)
+    for p, _h, _m, ev, _hl in loaded:
+        snaps = [e for e in ev if e.get("event") == "memory_snapshot"]
+        if snaps:
+            tag = "fleet" if p == fpath else os.path.basename(p)
+            mem_last.append((tag, snaps[-1], len(snaps)))
+    if mem_last:
+        print("  -- merged memory (per-worker ledgers) --")
+        for tag, last, n in mem_last:
+            comps = last.get("components") or {}
+            top = sorted(comps.items(), key=lambda kv: -kv[1])[:3]
+            print(f"    {tag:<28} device "
+                  f"{_fmt_bytes(last.get('device_bytes', 0)):>10} "
+                  f"({n} snapshot(s): "
+                  + ", ".join(f"{k} {_fmt_bytes(v)}" for k, v in top)
+                  + ")")
+        workers_total = sum(last.get("device_bytes", 0)
+                            for tag, last, _n in mem_last
+                            if tag != "fleet")
+        if workers_total:
+            print("    fleet device total (workers): "
+                  f"{_fmt_bytes(workers_total)}")
     print(f"\n== fleet file: {fpath} ==")
     _p, header, metrics, events, health = fleet
     summarize(header, metrics, events)
     summarize_compile(metrics, events)
     summarize_fleet(metrics, events, health)
     summarize_serving(metrics, events)
+    summarize_memory(metrics, events)
     summarize_health(health)
     if trace:
         # lazy: obs pulls in jax; only the trace path needs it
@@ -622,6 +647,80 @@ def summarize_prefix_kv(metrics, events):
                   f"{1e3 * val:8.3f} ms/tick  "
                   f"({share:.0f}% of tick wall, "
                   f"{r.get('prefill_chunks', 0)} chunks)")
+
+
+def summarize_memory(metrics, events):
+    """Memory observatory section (obs/memory.py): the ledger's
+    composition table (per-component resident bytes + run high
+    watermark) per source (engine/trainer), attribution peaks from the
+    labeled series (per-tenant live KV, per-namespace prefix bytes,
+    per-tenant adapter rows), request-level KV peaks and prefix
+    savings, and every drift/pressure incident the detectors fired."""
+    snaps = [e for e in events if e["event"] == "memory_snapshot"]
+    drift = [e for e in events if e["event"] == "memory_drift"]
+    pressure = [e for e in events if e["event"] == "memory_pressure"]
+    if not (snaps or drift or pressure):
+        return
+    print("\n-- memory --")
+    by_src = {}
+    for e in snaps:
+        by_src.setdefault(e.get("source", "?"), []).append(e)
+    for src, rows in sorted(by_src.items()):
+        last = rows[-1]
+        comps = last.get("components") or {}
+        peaks = {}
+        for r in rows:
+            for name, size in (r.get("components") or {}).items():
+                if size > peaks.get(name, -1):
+                    peaks[name] = size
+        line = (f"  {src}: {len(rows)} snapshot(s), "
+                f"last total {_fmt_bytes(last.get('total_bytes', 0))}")
+        if isinstance(last.get("headroom_bytes"), (int, float)):
+            line += (f", headroom {_fmt_bytes(last['headroom_bytes'])}"
+                     f" of {_fmt_bytes(last.get('capacity_bytes', 0))}")
+        print(line)
+        for name in sorted(comps, key=lambda n: -comps[n]):
+            print(f"    {name:<16} {_fmt_bytes(comps[name]):>12}"
+                  f"   peak {_fmt_bytes(peaks.get(name, comps[name]))}")
+        # attribution: per-label high watermark over the whole run
+        lab_peaks = {}
+        for r in rows:
+            for series, sizes in (r.get("labeled") or {}).items():
+                d = lab_peaks.setdefault(series, {})
+                for key, size in sizes.items():
+                    if size > d.get(key, -1):
+                        d[key] = size
+        for series, d in sorted(lab_peaks.items()):
+            top = sorted(d.items(), key=lambda kv: -kv[1])[:6]
+            print(f"    {series} peak: "
+                  + ", ".join(f"{k}={_fmt_bytes(v)}" for k, v in top)
+                  + (f" (+{len(d) - len(top)} more)"
+                     if len(d) > len(top) else ""))
+    done = [e for e in events if e["event"] == "request_done"]
+    kv_peaks = [e["kv_bytes_peak"] for e in done
+                if isinstance(e.get("kv_bytes_peak"), (int, float))]
+    saved = sum(e.get("prefix_bytes_saved", 0) for e in done
+                if isinstance(e.get("prefix_bytes_saved"), (int, float)))
+    if kv_peaks:
+        print(f"  request KV: peak {_fmt_bytes(max(kv_peaks))}/req, "
+              f"p95 {_fmt_bytes(_pctile(kv_peaks, 95))}"
+              + (f"; {_fmt_bytes(saved)} of prefill KV saved by prefix "
+                 "hits" if saved else ""))
+    for e in drift:
+        extra = ""
+        if isinstance(e.get("delta_bytes"), (int, float)):
+            extra = f", delta {_fmt_bytes(abs(e['delta_bytes']))}"
+        elif isinstance(e.get("pinned_bytes"), (int, float)):
+            extra = f", {_fmt_bytes(e['pinned_bytes'])} pinned"
+        print(f"  !! memory_drift [{e.get('component')}] "
+              f"{e.get('reason')}{extra} — the ledger disagrees with "
+              "the live arrays; suspect a leak in this component")
+    for e in pressure:
+        print(f"  !! memory_pressure at "
+              f"{100 * e.get('used_frac', 0):.1f}% of "
+              f"{_fmt_bytes(e.get('capacity_bytes', 0))} "
+              f"(headroom {_fmt_bytes(e.get('headroom_bytes', 0))}) — "
+              "full breakdown rides the event")
 
 
 def summarize_ticks(metrics, events):
@@ -1162,6 +1261,7 @@ def main(argv=None):
     summarize_compile(metrics, events)
     summarize_fleet(metrics, events, health)
     summarize_serving(metrics, events)
+    summarize_memory(metrics, events)
     summarize_health(health)
     if args.trace:
         from building_llm_from_scratch_tpu.obs.trace import (

@@ -1,0 +1,521 @@
+"""Memory observatory tests (obs/memory.py + engine/trainer/trace
+integration): byte-exact ledger components vs live pytree ``nbytes``
+(fp32 / bf16 / int8+sidecar), reconcile/growth/probe/pressure
+detectors (injected pinned-pane leak fires ``memory_drift`` naming the
+component), per-namespace and per-tenant attribution, request_done
+``kv_bytes_peak``/``prefix_bytes_saved``, zero recompiles + zero
+implicit transfers with the ledger armed at tick cadence, and
+byte-deterministic Perfetto memory counter tracks.
+"""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from building_llm_from_scratch_tpu.configs import ModelConfig
+from building_llm_from_scratch_tpu.models import init_params
+from building_llm_from_scratch_tpu.obs.memory import (
+    MemoryLedger,
+    pytree_nbytes,
+)
+from building_llm_from_scratch_tpu.obs.metrics import configure_metrics
+from building_llm_from_scratch_tpu.serving import (
+    DecodeEngine,
+    KVCachePolicy,
+    SamplingParams,
+)
+from building_llm_from_scratch_tpu.serving.kvcache import cache_nbytes
+
+
+def tiny_cfg(ctx=256, **kw):
+    base = dict(name="mem-tiny", vocab_size=96, context_length=ctx,
+                emb_dim=32, n_heads=2, n_layers=2, hidden_dim=64,
+                n_kv_groups=2, norm="layernorm", positional="learned",
+                activation="gelu", drop_rate=0.0, eos_id=1)
+    base.update(kw)
+    return ModelConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_cfg()
+    return cfg, init_params(cfg, jax.random.PRNGKey(0))
+
+
+def shared_prefix_prompts(cfg, n, prefix_len=40, seed=0):
+    rng = np.random.default_rng(seed)
+    prefix = rng.integers(2, cfg.vocab_size, (prefix_len,)).astype(np.int32)
+    return [np.concatenate([prefix, rng.integers(
+        2, cfg.vocab_size, (2 + i % 3,)).astype(np.int32)])
+        for i in range(n)]
+
+
+def capture_ledger(**kw):
+    """A ledger whose emitted events land in a plain list (no metrics
+    sink), with device polling stubbed off unless a test injects it."""
+    events = []
+
+    def emit(kind, **fields):
+        events.append((kind, fields))
+
+    kw.setdefault("poll_device", False)
+    kw.setdefault("auto_capacity", False)
+    return MemoryLedger(emit=emit, **kw), events
+
+
+# ---------------------------------------------------------------------------
+# MemoryLedger units: measurement, watermarks, detectors
+# ---------------------------------------------------------------------------
+
+def test_snapshot_watermarks_and_totals():
+    led, events = capture_ledger(source="unit")
+    sizes = {"a": 100, "b": 7}
+    led.register("a", lambda: sizes["a"])
+    led.register("b", lambda: sizes["b"], device=False)
+    led.observe()
+    assert led.device_bytes() == 100 and led.host_bytes() == 7
+    assert led.total_bytes() == 107
+    assert led.headroom_bytes() is None        # CPU: capacity unknown
+    sizes["a"] = 60                            # shrink: watermark sticks
+    led.observe()
+    assert led.sizes["a"] == 60 and led.watermarks["a"] == 100
+    snaps = [f for k, f in events if k == "memory_snapshot"]
+    assert len(snaps) == 2
+    assert snaps[-1]["components"] == {"a": 60, "b": 7}
+    assert snaps[-1]["source"] == "unit"
+    assert "capacity_bytes" not in snaps[-1]   # n/a-safe by absence
+    assert not [k for k, _ in events if k == "memory_drift"]
+
+
+def test_reconcile_drift_is_byte_exact():
+    led, events = capture_ledger()
+    measured = {"n": 4096}
+    led.register("slot_kv", lambda: measured["n"], expected=lambda: 4096)
+    led.observe()
+    assert not [k for k, _ in events if k == "memory_drift"]
+    measured["n"] = 4097                       # off by ONE byte -> drift
+    led.observe()
+    drifts = [f for k, f in events if k == "memory_drift"]
+    assert len(drifts) == 1
+    d = drifts[0]
+    assert d["component"] == "slot_kv" and d["reason"] == "reconcile"
+    assert d["expected_bytes"] == 4096 and d["measured_bytes"] == 4097
+    assert d["delta_bytes"] == 1
+    assert led.n_drift_events == 1
+
+
+def test_monotonic_growth_leak_detector_fires_once_and_rearms():
+    led, events = capture_ledger(growth_streak=3)
+    sizes = {"pool": 10}
+    led.register("pool", lambda: sizes["pool"])
+    for _ in range(4):                         # 3 consecutive grows
+        led.observe()
+        sizes["pool"] += 5
+    drifts = [f for k, f in events if k == "memory_drift"]
+    assert len(drifts) == 1
+    assert drifts[0]["component"] == "pool"
+    assert drifts[0]["reason"] == "monotonic_growth"
+    assert drifts[0]["streak"] == 3
+    led.observe()                              # still growing: fired once
+    sizes["pool"] += 5
+    led.observe()
+    assert len([f for k, f in events if k == "memory_drift"]) == 1
+    sizes["pool"] = 10                         # shrink: re-arm
+    led.observe()
+    for _ in range(4):
+        led.observe()
+        sizes["pool"] += 5
+    assert len([f for k, f in events if k == "memory_drift"]) == 2
+
+
+def test_pressure_flight_recorder_and_hysteresis():
+    led, events = capture_ledger(capacity_bytes=1000, pressure_frac=0.9)
+    sizes = {"kv": 500}
+    led.register("kv", lambda: sizes["kv"])
+    led.register_labeled("kv_live_bytes", "tenant",
+                         lambda: {"base": sizes["kv"]})
+    led.observe()
+    assert not [k for k, _ in events if k == "memory_pressure"]
+    sizes["kv"] = 950                          # upward crossing
+    led.observe()
+    led.observe()                              # still above: no re-fire
+    press = [f for k, f in events if k == "memory_pressure"]
+    assert len(press) == 1
+    p = press[0]
+    # the near-OOM dump: the FULL breakdown rides the event
+    assert p["components"] == {"kv": 950}
+    assert p["labeled"] == {"kv_live_bytes": {"base": 950}}
+    assert p["capacity_bytes"] == 1000 and p["headroom_bytes"] == 50
+    assert p["used_frac"] == 0.95
+    sizes["kv"] = 500                          # fall below: re-arm
+    led.observe()
+    sizes["kv"] = 990
+    led.observe()
+    assert len([f for k, f in events if k == "memory_pressure"]) == 2
+    assert led.n_pressure_events == 2
+
+
+def test_labeled_attribution_peaks_and_gauges():
+    led, _ = capture_ledger()
+    live = {"ta": 10, "tb": 30}
+    led.register("kv", lambda: sum(live.values()))
+    led.register_labeled("kv_live_bytes", "tenant", lambda: dict(live))
+    led.observe()
+    live["ta"], live["tb"] = 50, 5             # ta peaks later, tb earlier
+    led.observe()
+    g = led.gauges()
+    assert g['kv_live_bytes{tenant="ta"}'] == 50
+    assert g['kv_live_bytes_peak{tenant="ta"}'] == 50
+    assert g['kv_live_bytes{tenant="tb"}'] == 5
+    assert g['kv_live_bytes_peak{tenant="tb"}'] == 30
+    assert g['mem_component_bytes{component="kv"}'] == 55
+    assert g["mem_total_bytes"] == 55
+
+
+def test_probe_violation_fires_drift_with_custom_reason():
+    led, events = capture_ledger()
+    led.register("store", lambda: 64)
+    state = {"pinned": 0}
+    led.register_probe(
+        "store",
+        lambda: ({"reason": "pinned_orphan",
+                  "pinned_bytes": state["pinned"]}
+                 if state["pinned"] else None))
+    led.observe()
+    assert not [k for k, _ in events if k == "memory_drift"]
+    state["pinned"] = 32
+    led.observe()
+    drifts = [f for k, f in events if k == "memory_drift"]
+    assert len(drifts) == 1
+    assert drifts[0]["component"] == "store"
+    assert drifts[0]["reason"] == "pinned_orphan"
+    assert drifts[0]["pinned_bytes"] == 32
+
+
+def test_device_divergence_vs_runtime_accounting():
+    led, events = capture_ledger(
+        poll_device=True, device_drift_min_bytes=100,
+        device_stats_fn=lambda: {"bytes_in_use": 10_000,
+                                 "peak_bytes_in_use": 12_000},
+        rss_fn=lambda: None)
+    led.register("kv", lambda: 500)            # ledger knows 500 of 10000
+    led.observe()
+    drifts = [f for k, f in events if k == "memory_drift"]
+    assert len(drifts) == 1
+    assert drifts[0]["component"] == "device"
+    assert drifts[0]["reason"] == "device_divergence"
+    assert drifts[0]["device_bytes"] == 10_000
+    assert drifts[0]["ledger_bytes"] == 500
+    # the POLLED numbers stay out of the deterministic snapshot event...
+    snap = [f for k, f in events if k == "memory_snapshot"][0]
+    assert "hbm_bytes_in_use" not in snap
+    assert snap["components"] == {"kv": 500}
+    # ...and surface in the gauges instead
+    g = led.gauges()
+    assert g["hbm_bytes_in_use"] == 10_000
+    assert g["hbm_peak_bytes"] == 12_000
+
+
+def test_pytree_nbytes_matches_manual_sum(model):
+    _cfg, params = model
+    manual = sum(leaf.nbytes for leaf in jax.tree_util.tree_leaves(params))
+    assert pytree_nbytes(params) == manual > 0
+
+
+# ---------------------------------------------------------------------------
+# Engine integration: byte-exact components across KV dtypes
+# ---------------------------------------------------------------------------
+
+def _slot_kv_sum(snap):
+    return snap["slot_kv"] + snap.get("kv_scales", 0)
+
+
+@pytest.mark.parametrize("dtype,policy", [
+    ("fp32", KVCachePolicy()),
+    ("bf16", KVCachePolicy()),
+    ("fp32", KVCachePolicy(kv_quant="int8")),
+], ids=["fp32", "bf16", "int8"])
+def test_engine_slot_kv_byte_exact_vs_pytree(dtype, policy):
+    """The acceptance invariant: the ledger's slot-KV (+ int8 sidecar)
+    component equals BOTH the live cache pytree's nbytes sum and the
+    policy's ``bytes_per_slot x n_slots`` — measured, expected, and
+    actual all byte-identical, per KV dtype."""
+    cfg = tiny_cfg(dtype=dtype)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    eng = DecodeEngine(cfg, params, n_slots=3, max_len=64,
+                       warmup_prompt_cap=32, kv_policy=policy,
+                       watch_compiles=False)
+    snap = eng.memory_ledger.snapshot()
+    bps = policy.bytes_per_slot(cfg, 64)
+    assert snap["slot_kv"] == bps["kv_bytes"] * 3
+    assert _slot_kv_sum(snap) == cache_nbytes(eng.cache)
+    assert _slot_kv_sum(snap) == bps["total_bytes"] * 3
+    if policy.quantized:
+        assert snap["kv_scales"] == bps["scale_bytes"] * 3 > 0
+    else:
+        assert "kv_scales" not in snap
+    assert snap["model_params"] == pytree_nbytes(eng.params)
+    eng.shutdown()
+
+
+def test_engine_spec_headroom_component(model):
+    """With speculative decoding the cache rows are ``max_len + k`` long;
+    the ledger carves the +k tail into its own component so slot_kv
+    still reconciles byte-exactly against bytes_per_slot(max_len)."""
+    cfg, params = model
+    eng = DecodeEngine(cfg, params, n_slots=2, max_len=64,
+                       warmup_prompt_cap=32, spec_k=4,
+                       watch_compiles=False)
+    snap = eng.memory_ledger.snapshot()
+    bps = eng.kv_policy.bytes_per_slot(cfg, 64)
+    assert snap["slot_kv"] == bps["kv_bytes"] * 2
+    assert snap["spec_headroom"] > 0
+    assert (snap["slot_kv"] + snap["spec_headroom"]
+            == cache_nbytes(eng.cache))
+    # no drift: expected callables cover the carve-out exactly
+    events = []
+    eng.memory_ledger._emit = lambda kind, **f: events.append(kind)
+    eng.memory_ledger.observe()
+    assert "memory_drift" not in events
+    eng.shutdown()
+
+
+def test_prefix_attribution_and_request_done_fields(model, tmp_path):
+    """Live attribution end-to-end: per-namespace prefix-store bytes,
+    per-tenant live-KV series, and the new ``request_done`` fields —
+    ``kv_bytes_peak`` on every request, ``prefix_bytes_saved`` on the
+    sharers whose prefix arrived by pane copy."""
+    cfg, params = model
+    mj = str(tmp_path / "m.jsonl")
+    sink = configure_metrics(mj)
+    sink.write_header(test="memory_obs_attribution")
+    try:
+        eng = DecodeEngine(cfg, params, n_slots=3, max_len=128,
+                           warmup_prompt_cap=64, metrics_every=2,
+                           kv_policy=KVCachePolicy(prefill_chunk=16,
+                                                   prefix_cache=True))
+        eng.warmup()
+        prompts = shared_prefix_prompts(cfg, 3)
+        sp = SamplingParams(max_new_tokens=4, ignore_eos=True, seed=0)
+        eng.submit(prompts[0], sp)
+        eng.run_until_idle()                  # donor stores the prefix
+        for p in prompts[1:]:
+            eng.submit(p, sp)
+        eng.run_until_idle()
+        snap = eng.memory_ledger.snapshot()
+        assert snap["prefix_store"] == eng.prefix_store.bytes_total > 0
+        assert (eng.prefix_store.bytes_by_tag()
+                == {"base": eng.prefix_store.bytes_total})
+        g = eng.memory_ledger.gauges()
+        assert g['prefix_store_bytes{namespace="base"}'] > 0
+        assert g['kv_live_bytes_peak{tenant="base"}'] > 0
+        eng.shutdown()
+    finally:
+        sink.close()
+        configure_metrics(None)
+    rows = [json.loads(line) for line in open(mj)]
+    done = [r for r in rows if r.get("event") == "request_done"]
+    assert len(done) == 3
+    kv_tok = eng._kv_bytes_per_token
+    for r in done:
+        # committed length x bytes/token, a host-math byte count
+        assert r["kv_bytes_peak"] > 0
+        assert r["kv_bytes_peak"] % kv_tok == 0
+    saved = [r["prefix_bytes_saved"] for r in done
+             if r.get("prefix_bytes_saved")]
+    assert len(saved) == 2                    # both sharers hit
+    assert all(s % kv_tok == 0 for s in saved)
+    snaps = [r for r in rows if r.get("event") == "memory_snapshot"]
+    assert snaps and all(r["source"] == "engine" for r in snaps)
+    assert not [r for r in rows if r.get("event") == "memory_drift"]
+
+
+def test_pinned_pane_leak_fires_drift_naming_component(model):
+    """The injected leak of the acceptance criteria: a prefix pane still
+    pinned at cadence (match without release — the pinned-forever bug)
+    fires ``memory_drift`` naming ``prefix_store``."""
+    cfg, params = model
+    eng = DecodeEngine(cfg, params, n_slots=2, max_len=128,
+                       warmup_prompt_cap=64,
+                       kv_policy=KVCachePolicy(prefill_chunk=16,
+                                               prefix_cache=True))
+    eng.warmup()
+    prompts = shared_prefix_prompts(cfg, 1)
+    eng.submit(prompts[0],
+               SamplingParams(max_new_tokens=2, ignore_eos=True))
+    eng.run_until_idle()
+    events = []
+    eng.memory_ledger._emit = (
+        lambda kind, **f: events.append((kind, f)))
+    eng.memory_ledger.observe()               # healthy: pins transient
+    assert not [k for k, _ in events if k == "memory_drift"]
+    span, entry = eng.prefix_store.match(prompts[0], "base")  # pin, no rel
+    assert span > 0 and entry is not None and entry.pins == 1
+    eng.memory_ledger.observe()
+    drifts = [f for k, f in events if k == "memory_drift"]
+    assert len(drifts) == 1
+    assert drifts[0]["component"] == "prefix_store"
+    assert drifts[0]["reason"] == "pinned_orphan"
+    assert drifts[0]["pinned_bytes"] == entry.nbytes
+    eng.prefix_store.release(entry)           # fix the leak: drift stops
+    events.clear()
+    eng.memory_ledger.observe()
+    assert not [k for k, _ in events if k == "memory_drift"]
+    eng.shutdown()
+
+
+def test_ledger_armed_zero_recompiles_zero_implicit_transfers(model):
+    """With the ledger observing at EVERY tick (metrics_every=1) a
+    serving burst still runs with zero implicit device->host transfers
+    (the ledger is nbytes metadata math) and zero recompiles — the
+    observatory must not perturb the engine's invariants."""
+    from building_llm_from_scratch_tpu.analysis.runtime import (
+        no_implicit_device_to_host,
+    )
+
+    cfg, params = model
+    eng = DecodeEngine(cfg, params, n_slots=2, max_len=64,
+                       metrics_every=1, watch_compiles=False)
+    eng.warmup()
+    handles = [eng.submit(np.array([3, 4 + i], np.int32),
+                          SamplingParams(max_new_tokens=6,
+                                         ignore_eos=True, seed=i))
+               for i in range(3)]
+    with no_implicit_device_to_host():
+        eng.run_until_idle()
+    for h in handles:
+        h.result(timeout=10)
+    assert eng.memory_ledger.n_snapshots >= eng.n_ticks >= 3
+    assert eng.n_recompiles == 0
+    # the scrape path is metadata-only too
+    with no_implicit_device_to_host():
+        eng.memory_ledger.snapshot()
+        eng.memory_ledger.gauges()
+    eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Trace + trainer + schema integration
+# ---------------------------------------------------------------------------
+
+def _run_traced_engine(model, mj):
+    cfg, params = model
+    sink = configure_metrics(mj)
+    sink.write_header(test="memory_obs_trace")
+    try:
+        eng = DecodeEngine(cfg, params, n_slots=2, max_len=128,
+                           warmup_prompt_cap=64, metrics_every=2,
+                           kv_policy=KVCachePolicy(prefill_chunk=16,
+                                                   prefix_cache=True))
+        eng.warmup()
+        for p in shared_prefix_prompts(cfg, 2):
+            eng.submit(p, SamplingParams(max_new_tokens=4,
+                                         ignore_eos=True, seed=1))
+            eng.run_until_idle()
+        eng.shutdown()
+    finally:
+        sink.close()
+        configure_metrics(None)
+
+
+def test_memory_counter_tracks_byte_deterministic(model, tmp_path):
+    """Two identical runs -> byte-identical Perfetto memory counter
+    tracks: the snapshot event carries only deterministic nbytes math,
+    and the polled ``host_rss`` component stays OFF the device
+    composition track."""
+    from building_llm_from_scratch_tpu.obs.trace import (
+        export_chrome_trace,
+    )
+
+    counters = []
+    for tag in ("a", "b"):
+        mj = str(tmp_path / f"{tag}.jsonl")
+        _run_traced_engine(model, mj)
+        tr = str(tmp_path / f"{tag}_trace.json")
+        export_chrome_trace(mj, tr)
+        evs = json.load(open(tr))["traceEvents"]
+        counters.append([e["args"] for e in evs
+                         if e.get("ph") == "C"
+                         and e.get("name") == "memory (bytes)"])
+    assert counters[0], "no memory counter samples in the trace"
+    assert counters[0] == counters[1]
+    assert all("host_rss" not in args for args in counters[0])
+    assert all(args["slot_kv"] > 0 for args in counters[0])
+
+
+def test_trainer_ledger_and_legacy_row_keys(tmp_path):
+    """The trainer's ad-hoc HBM/RSS gauges now read FROM the ledger:
+    cadence rows keep the historical ``host_rss_bytes`` key (renderer /
+    plot compatibility) and ``memory_snapshot`` events with
+    source=trainer carry params + optimizer state measured from the
+    live train state."""
+    from building_llm_from_scratch_tpu.data.pretrain import PretrainLoader
+    from building_llm_from_scratch_tpu.data.tokenizers import ByteTokenizer
+    from building_llm_from_scratch_tpu.training.trainer import Trainer
+
+    cfg = tiny_cfg(ctx=32, vocab_size=256, eos_id=0, name="mem-train")
+    tok = ByteTokenizer()
+    datafile = tmp_path / "corpus.txt"
+    datafile.write_text("memory ledger corpus " * 40)
+    mj = str(tmp_path / "train_metrics.jsonl")
+    sink = configure_metrics(mj)
+    sink.write_header(test="memory_obs_trainer")
+    try:
+        loader = PretrainLoader(tok, batch_size=4,
+                                max_length=cfg.context_length)
+        trainer = Trainer(cfg, init_params(cfg, jax.random.PRNGKey(0)),
+                          tok, loader, output_dir=str(tmp_path / "out"),
+                          eval_freq=10**6, print_sample_iter=10**6,
+                          save_ckpt_freq=10**6, warmup_steps=2,
+                          log_every=2, show_progress=False)
+        trainer.train_model([str(datafile)], 1)
+        assert trainer.global_step >= 4
+        state = trainer.state
+        expected_params = (pytree_nbytes(state["trainable"])
+                           + pytree_nbytes(state["frozen"]))
+        expected_opt = pytree_nbytes(state["opt_state"])
+    finally:
+        sink.close()
+        configure_metrics(None)
+    rows = [json.loads(line) for line in open(mj)]
+    cadence = [r for r in rows if r.get("type") == "metrics"
+               and "host_rss_bytes" in r]
+    assert cadence, "cadence rows lost the legacy host_rss_bytes key"
+    snaps = [r for r in rows if r.get("event") == "memory_snapshot"
+             and r.get("source") == "trainer"]
+    assert snaps
+    last = snaps[-1]["components"]
+    assert last["model_params"] == expected_params
+    assert last["optimizer_state"] == expected_opt > 0
+    assert last["host_rss"] > 0
+    assert not [r for r in rows if r.get("event") == "memory_drift"]
+
+
+def test_schema_v11_registers_memory_events():
+    from building_llm_from_scratch_tpu.obs import schema as S
+
+    assert S.SCHEMA_VERSION == 11
+    assert "memory_drift" in S.INCIDENT_EVENTS
+    assert "memory_pressure" in S.INCIDENT_EVENTS
+    # snapshots are counter-track cadence data, not incidents
+    assert "memory_snapshot" not in S.INCIDENT_EVENTS
+    assert S.validate_event("memory_snapshot",
+                            {"source": "engine",
+                             "components": {"slot_kv": 1},
+                             "total_bytes": 1, "device_bytes": 1}) == []
+    assert S.validate_event("memory_drift",
+                            {"component": "prefix_store",
+                             "reason": "pinned_orphan",
+                             "pinned_bytes": 9}) == []
+    assert S.validate_event("memory_pressure",
+                            {"headroom_bytes": 5, "capacity_bytes": 100,
+                             "used_frac": 0.95,
+                             "components": {"kv": 95}}) == []
+    # missing required fields are caught
+    assert S.validate_event("memory_drift", {"component": "x"})
+    # request_done accepts the new attribution fields
+    spec = S.EVENTS["request_done"]
+    assert "kv_bytes_peak" in spec.optional
+    assert "prefix_bytes_saved" in spec.optional
