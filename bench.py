@@ -1075,6 +1075,11 @@ def bench_serve_mem(n_requests=12, prefix_len=192, suffix_len=8,
     arms = {
         "prefix_off": KVCachePolicy(prefill_chunk=chunk),
         "prefix_on": KVCachePolicy(prefill_chunk=chunk, prefix_cache=True),
+        # the ROADMAP-item-1 arm: page-table KV — prefix hits are TABLE
+        # WRITES against refcounted shared pages, so the duplication the
+        # prefix_on arm leaves on the table collapses to ~1x
+        "paged": KVCachePolicy(prefill_chunk=chunk, prefix_cache=True,
+                               paged=True, page_tokens=16),
     }
     detail = {}
     headline = None
@@ -1095,7 +1100,29 @@ def bench_serve_mem(n_requests=12, prefix_len=192, suffix_len=8,
                               warmup_prompt_cap=cap, kv_policy=policy,
                               metrics_every=1)
         engine.warmup()
-        handles = [engine.submit(p, sp, block=True) for p in prompts]
+        on_token = None
+        if policy.paged:
+            # physical prefix residency, sampled at every token commit:
+            # the distinct PHYSICAL pages backing the shared prefix span
+            # across all active slots. Contiguous arms hold one pane
+            # COPY per sharer; shared refcounted pages keep this at the
+            # store's own page count (duplication_x == 1.0)
+            n_prefix_pages = prefix_len // policy.page_tokens
+            peak_prefix_pages = [0]
+
+            def on_token(_req, _tok, _txt):
+                tab, cols = engine._page_table, engine._slot_cols
+                pages = set()
+                for s in range(n_slots):
+                    if cols[s] >= n_prefix_pages:
+                        pages.update(
+                            int(p) for p in tab[s, :n_prefix_pages])
+                pages.discard(0)
+                if len(pages) > peak_prefix_pages[0]:
+                    peak_prefix_pages[0] = len(pages)
+
+        handles = [engine.submit(p, sp, block=True, on_token=on_token)
+                   for p in prompts]
         engine.run_until_idle()
         for h in handles:
             assert len(h.output_ids) == max_new, h.finish_reason
@@ -1106,7 +1133,9 @@ def bench_serve_mem(n_requests=12, prefix_len=192, suffix_len=8,
             ledger.labeled_peaks.get("kv_live_bytes", {}).values(),
             default=0)
         row = {
-            "slot_kv_bytes": snap["slot_kv"] + snap.get("kv_scales", 0),
+            "slot_kv_bytes": (snap["page_pool"] if policy.paged
+                              else snap["slot_kv"] + snap.get("kv_scales",
+                                                              0)),
             "kv_live_peak_bytes": live_peak,
             "kv_bytes_peak_sum": sum(h.kv_bytes_peak for h in handles),
             "mem_total_bytes": gauges["mem_total_bytes"],
@@ -1115,16 +1144,29 @@ def bench_serve_mem(n_requests=12, prefix_len=192, suffix_len=8,
         if engine.prefix_store is not None:
             st = engine.prefix_store.stats()
             saved = sum(h.prefix_bytes_saved for h in handles)
-            row["prefix_store_bytes"] = snap["prefix_store"]
+            row["prefix_store_bytes"] = (
+                engine.prefix_store.bytes_total if policy.paged
+                else snap["prefix_store"])
             row["prefix_hits"] = st["hits"]
             row["prefix_bytes_saved"] = saved
-            if snap["prefix_store"]:
+            if policy.paged:
+                # shared pages make duplication PHYSICAL, so it is
+                # measured physically: distinct pages backing the
+                # prefix span at peak / the store's own page count
+                pool = engine.page_pool.stats()
+                row["page_pool_peak_bytes"] = (pool["peak_used"]
+                                               * pool["page_bytes"])
+                row["pane_copies"] = engine.pane_copies
+                row["pane_copy_duplication_x"] = round(
+                    peak_prefix_pages[0] / n_prefix_pages, 2)
+            elif snap["prefix_store"]:
                 # peak live KV / the single stored pane set: how many
                 # resident COPIES of the shared prefix the slot
                 # carve-out holds at peak (the paged-KV target is ~1)
                 row["pane_copy_duplication_x"] = round(
                     live_peak / snap["prefix_store"], 2)
-            headline = float(saved)
+            if arm == "prefix_on":
+                headline = float(saved)
         detail[arm] = row
         engine.shutdown()
         configure_metrics(None)              # close + detach the arm sink
@@ -1132,6 +1174,11 @@ def bench_serve_mem(n_requests=12, prefix_len=192, suffix_len=8,
     if off["kv_live_peak_bytes"]:
         detail["live_peak_ratio_prefix"] = round(
             on["kv_live_peak_bytes"] / off["kv_live_peak_bytes"], 3)
+        # physical pool bytes at peak vs the contiguous arm's live KV:
+        # the oversubscription headroom paged KV actually buys
+        detail["physical_peak_ratio_paged"] = round(
+            detail["paged"]["page_pool_peak_bytes"]
+            / off["kv_live_peak_bytes"], 3)
     print(json.dumps(detail), flush=True)
     return _result("serve_mem", f"serve_mem prefix_bytes_saved GPT2-124M "
                    f"{dtype} {n_requests}req shared-{prefix_len}tok-prefix "
@@ -1492,6 +1539,53 @@ def bench_micro_serve():
                    detail=detail)
 
 
+def bench_micro_paged():
+    """Debug-size paged-KV engine (2 slots, 6 shared-prefix requests):
+    the gate workload for the page-table serving tier — its fingerprint
+    covers the paged compiled-program family (paged chunk prefill +
+    paged decode), so page-identity leaking into shapes (a table-churn
+    recompile), an extra program, or FLOP growth in the gather path
+    fails the structural gate with the program named."""
+    from building_llm_from_scratch_tpu.configs import get_config
+    from building_llm_from_scratch_tpu.models import init_params
+    from building_llm_from_scratch_tpu.serving import (
+        DecodeEngine,
+        KVCachePolicy,
+        SamplingParams,
+    )
+
+    n_requests, max_new = 6, 4
+    cfg = get_config("GPT2", "124M", dtype="fp32", debug=True)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    prefix = rng.integers(0, cfg.vocab_size, (8,)).astype(np.int32)
+    prompts = [np.concatenate([prefix, rng.integers(
+        0, cfg.vocab_size, (1,)).astype(np.int32)])
+        for _ in range(n_requests)]
+    sp = SamplingParams(max_new_tokens=max_new, ignore_eos=True)
+    policy = KVCachePolicy(paged=True, page_tokens=8, prefill_chunk=8,
+                           prefix_cache=True)
+    engine = DecodeEngine(cfg, params, n_slots=2, max_queue=n_requests,
+                          warmup_prompt_cap=9, kv_policy=policy,
+                          metrics_every=2)
+    engine.warmup()
+    t0 = time.perf_counter()
+    handles = [engine.submit(p, sp, block=True) for p in prompts]
+    engine.run_until_idle()
+    dt = time.perf_counter() - t0
+    for h in handles:
+        assert len(h.output_ids) == max_new, h.finish_reason
+    assert engine.pane_copies == 0, "paged hit copied panes"
+    detail = {"recompiles": engine.n_recompiles,
+              "prefix_hits": engine.prefix_store.stats()["hits"],
+              "page_pool": engine.page_pool.stats()}
+    engine.shutdown()
+    return _result("micro_paged", "paged serve tokens/sec GPT2-debug "
+                   f"fp32 {n_requests}req x {max_new}new slots2 page8",
+                   n_requests * max_new / dt, unit="tokens/sec",
+                   detail=detail)
+
+
 def bench_micro_lora_fusion():
     """Debug-size fused multi-LoRA train step (2 jobs x 2 rows, rank 4):
     the gate workload for the fused-finetune tier. Its fingerprint pins
@@ -1597,6 +1691,7 @@ BENCHES = {
     "micro_train": bench_micro_train,
     "micro_accum": bench_micro_accum,
     "micro_serve": bench_micro_serve,
+    "micro_paged": bench_micro_paged,
     "micro_lora_fusion": bench_micro_lora_fusion,
     "micro_spec": bench_micro_spec,
     "micro_router": bench_micro_router,
@@ -1605,7 +1700,8 @@ BENCHES = {
 #: Micro-benches excluded from ``all`` (they are gate workloads, not
 #: performance claims — their tok/s on a debug model means nothing).
 MICRO_BENCHES = ("micro_train", "micro_accum", "micro_serve",
-                 "micro_lora_fusion", "micro_spec", "micro_router")
+                 "micro_paged", "micro_lora_fusion", "micro_spec",
+                 "micro_router")
 
 
 def _reset_compilation_cache() -> None:

@@ -61,6 +61,14 @@ HOT_PATHS = {
         "DecodeEngine._cache_component_bytes",
         "DecodeEngine._kv_live_by_tenant",
         "DecodeEngine._compile_temp_bytes",
+        # paged-KV per-tick bookkeeping: table writes + pool refcounts
+        # are host numpy/integer math — a device fetch here would sync
+        # every decode tick (and every admission)
+        "DecodeEngine._ensure_pages",
+        "DecodeEngine._admit_pages",
+        "DecodeEngine._page_need",
+        "DecodeEngine._release_slot_pages",
+        "DecodeEngine._apply_paged_hit",
     },
     "building_llm_from_scratch_tpu/obs/memory.py": {
         # the ledger's measurement/export surface: providers read array
@@ -90,6 +98,14 @@ HOT_PATHS = {
         # per-admission prefix probe: host-side hashing only — a device
         # fetch here would sync the tick on every admission
         "PrefixStore.match",
+        # page-pool bookkeeping runs inside the tick on every alloc/
+        # release: pure host lists + numpy refcounts
+        "PagePool.alloc",
+        "PagePool.incref",
+        "PagePool.decref",
+        "PagePool.available",
+        "PagePool.reserve",
+        "PagePool.unreserve",
     },
     "building_llm_from_scratch_tpu/serving/fleet.py": {
         # router-side per-request paths for the cross-process fleet:

@@ -130,6 +130,21 @@ def perform_checks(args) -> None:
                              "(0 = monolithic bucketed prefill).")
         if args.serve_prefix_budget_mb <= 0:
             raise ValueError("--serve_prefix_budget_mb must be > 0.")
+        if args.serve_kv_page_tokens < 1:
+            raise ValueError("--serve_kv_page_tokens must be >= 1.")
+        if args.serve_kv_paged == "on":
+            chunk = args.serve_prefill_chunk or 64
+            if chunk % args.serve_kv_page_tokens != 0:
+                raise ValueError(
+                    f"--serve_prefill_chunk {chunk} must be a whole "
+                    f"number of pages (--serve_kv_page_tokens "
+                    f"{args.serve_kv_page_tokens}): chunk scatters land "
+                    "on page boundaries.")
+            if args.serve_tp > 1:
+                raise ValueError(
+                    "--serve_kv_paged on cannot combine with "
+                    "--serve_tp > 1: the shared page pool has no "
+                    "heads-sharded placement (use replicas instead).")
         if args.serve_spec_k < 0:
             raise ValueError("--serve_spec_k must be >= 0 "
                              "(0 disables speculative decoding).")
@@ -164,6 +179,7 @@ def perform_checks(args) -> None:
             ("serve_adapters", None), ("serve_adapter_slots", 0),
             ("serve_prefix_cache", "off"), ("serve_prefill_chunk", 0),
             ("serve_kv_quant", "model"), ("serve_prefix_budget_mb", 256.0),
+            ("serve_kv_paged", "off"), ("serve_kv_page_tokens", 16),
             ("serve_spec_k", 0), ("serve_replicas", 1), ("serve_tp", 1),
             ("serve_workers", 0),
         ) if getattr(args, name) != default]
@@ -565,6 +581,27 @@ def get_args(argv=None):
                         help="Prefix-store byte budget (MiB of device "
                              "memory for cached prefix KV panes); least-"
                              "recently-used entries evict past it.")
+    parser.add_argument("--serve_kv_paged", type=str, default="off",
+                        choices=["on", "off"],
+                        help="Paged KV cache (serving/kvcache.py): slot "
+                             "KV lives in fixed-size pages drawn from a "
+                             "shared pool, addressed through a per-slot "
+                             "page table that rides the compiled "
+                             "programs as data. Prefix hits become "
+                             "shared refcounted page-table entries (zero "
+                             "copy), freed pages recycle across "
+                             "requests, and admission checks free PAGES "
+                             "(oversubscription), not free slots. "
+                             "Implies chunked prefill "
+                             "(--serve_prefill_chunk, default 64 when "
+                             "unset). 'off' keeps the contiguous layout "
+                             "byte-identical to prior releases.")
+    parser.add_argument("--serve_kv_page_tokens", type=int, default=16,
+                        help="Tokens per KV page when --serve_kv_paged "
+                             "on: small pages waste less on short tails "
+                             "but grow the table/gather width; the "
+                             "prefill chunk must be a whole number of "
+                             "pages. Ignored when paging is off.")
     parser.add_argument("--serve_spec_k", type=int, default=0,
                         help="Speculative decoding draft length: each "
                              "tick an n-gram drafter proposes this many "

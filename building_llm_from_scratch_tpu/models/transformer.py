@@ -1265,3 +1265,270 @@ def verify_slots(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     x = _norm(cfg, params["final_norm"], x)
     logits = _head_logits(x, params["head"]["weight"], head_node, head_s)
     return logits, new
+
+
+# ---------------------------------------------------------------------------
+# Paged slot paths (KVCachePolicy.paged; serving/engine.py)
+#
+# Same programs as the contiguous slot paths above with ONE layout change:
+# a row no longer owns a contiguous (Tmax,) lane — a per-slot int32 page
+# table maps each row's logical positions onto fixed-size pages of a
+# shared pool (cache leaves are (n_pages, Hkv, page_tokens, hd)). The
+# table rides every call as traced DATA against static shapes (the
+# adapter-pool trick), so page churn — prefix hits, frees, eviction,
+# oversubscription — never recompiles anything.
+#
+# Bit-parity with the contiguous layout is by construction: appends write
+# identical values at identical logical positions (the int8 quantization
+# grouping — per written position per head — is unchanged), the gather
+# view reassembles each row into the exact (S, Hkv, cache_len, ...)
+# buffer ``decode_attention`` saw before, and every position where the
+# two layouts could disagree (stale pool bytes vs. a row's leftover lane
+# garbage) is masked by ``kv_length`` in both — masked weights are
+# exactly zero and pool contents are always finite, so masked values
+# never reach the output.
+#
+# Table entry 0 is the TRASH PAGE: unallocated logical positions (a free
+# row's garbage-lane append, a final chunk's pad tail past the prompt)
+# scatter there and are only ever read masked. Duplicate scatter indices
+# therefore only ever collide on the trash page or on pad zeros — the
+# nondeterminism XLA allows for them can never reach an unmasked read.
+# ---------------------------------------------------------------------------
+
+def _paged_scatter(cache: Params, name: str, vals: jnp.ndarray,
+                   phys: jnp.ndarray, off: jnp.ndarray, new: Params) -> None:
+    """Scatter ``vals`` (R, Hkv, hd) — R written logical positions — into
+    the pool leaf at rows ``phys`` (R,) page ids / ``off`` (R,) in-page
+    offsets, quantizing on write under the int8 policy exactly like
+    ``_slot_write`` (same per-position per-head scale grouping, so codes
+    and sidecars are bitwise identical to the contiguous layout's)."""
+    buf = cache[name][len(new[name])]
+    if _cache_quantized(cache):
+        from building_llm_from_scratch_tpu.ops.decode_step import quantize_kv
+
+        codes, scale = quantize_kv(vals)
+        sbuf = cache[name + "_scale"][len(new[name + "_scale"])]
+        new[name + "_scale"].append(sbuf.at[phys, :, off].set(scale))
+        vals = codes
+    new[name].append(buf.at[phys, :, off].set(vals.astype(buf.dtype)))
+
+
+def _paged_append_kv(cache: Params, new: Params, l: int,
+                     k: jnp.ndarray, v: jnp.ndarray,
+                     lengths: jnp.ndarray, page_table: jnp.ndarray,
+                     cache_len: int) -> None:
+    """Paged sibling of ``_slot_append_kv``: append one layer's fresh
+    k/v (model layout (S, Tq, Hkv, hd)) at each row's logical offsets,
+    routed through the page table. Positions clamp to ``cache_len - 1``
+    (only ever binding for garbage lanes that are masked everywhere,
+    mirroring ``verify_slots``' position clamp)."""
+    S, Tq = k.shape[:2]
+    P = cache["k"][l].shape[2]
+    pos = jnp.minimum(lengths[:, None] + jnp.arange(Tq)[None, :],
+                      cache_len - 1)                        # (S, Tq)
+    phys = jnp.take_along_axis(page_table, pos // P, axis=1).reshape(-1)
+    off = (pos % P).reshape(-1)
+    _paged_scatter(cache, "k", k.reshape(S * Tq, *k.shape[2:]), phys, off,
+                   new)
+    _paged_scatter(cache, "v", v.reshape(S * Tq, *v.shape[2:]), phys, off,
+                   new)
+
+
+def _paged_view(leaf: jnp.ndarray, page_table: jnp.ndarray,
+                cache_len: int) -> jnp.ndarray:
+    """Gather a (rows, Hkv, cache_len, ...) row-major view out of the
+    pool leaf (n_pages, Hkv, P, ...) through the page table (rows, M):
+    the XLA reference for page-table attention — downstream
+    ``decode_attention`` is completely unchanged, which is what pins
+    bit-parity. The TPU pallas kernel (ops/decode_step.paged_gather_kv)
+    computes the same gather without materializing it per layer."""
+    g = leaf[page_table]                    # (rows, M, Hkv, P, ...)
+    g = jnp.moveaxis(g, 2, 1)               # (rows, Hkv, M, P, ...)
+    shape = g.shape
+    g = g.reshape(shape[0], shape[1], shape[2] * shape[3], *shape[4:])
+    return g[:, :, :cache_len]
+
+
+def _paged_layer_kv(new: Params, l: int, page_table: jnp.ndarray,
+                    cache_len: int):
+    """(K, V, scale kwargs) row views for layer ``l`` AFTER its paged
+    append — the paged sibling of slicing ``new['k'][l]`` directly plus
+    ``_layer_scales``."""
+    K = _paged_view(new["k"][l], page_table, cache_len)
+    V = _paged_view(new["v"][l], page_table, cache_len)
+    scales = {}
+    if "k_scale" in new:
+        scales = {
+            "k_scale": _paged_view(new["k_scale"][l], page_table, cache_len),
+            "v_scale": _paged_view(new["v_scale"][l], page_table, cache_len),
+        }
+    return K, V, scales
+
+
+def _use_paged_attn(cache: Params, cfg: ModelConfig) -> bool:
+    """Route decode attention through the pallas page-gather kernel
+    (ops/decode_step.paged_decode_attention). Opt-in via BLLM_PAGED_ATTN=1
+    on TPU — the same off-until-hardware-A/B discipline as
+    BLLM_FUSED_DECODE/BLLM_BGMV — and only for unquantized pools of
+    kernel-eligible shape; the XLA gather view is the reference."""
+    import os as _os
+
+    if jax.default_backend() != "tpu" or _cache_quantized(cache):
+        return False
+    if _os.environ.get("BLLM_PAGED_ATTN", "0") != "1":
+        return False
+    from building_llm_from_scratch_tpu.ops.decode_step import (
+        supports_paged_shape,
+    )
+
+    return supports_paged_shape(1, cache["k"][0].shape[2], cfg.head_dim)
+
+
+def paged_decode_slots(params: Params, cfg: ModelConfig,
+                       tokens: jnp.ndarray, lengths: jnp.ndarray,
+                       page_table: jnp.ndarray, cache: Params,
+                       blocks_list: Optional[list] = None,
+                       adapter: Optional[Params] = None, *,
+                       cache_len: int) -> Tuple[jnp.ndarray, Params]:
+    """Paged sibling of ``decode_slots``: one decode tick over the slot
+    batch with every cache read/write routed through ``page_table``
+    ((S, max_pages) int32, traced data). ``cache_len`` is the static
+    logical row length (the engine's ``_cache_len``), identical to the
+    contiguous buffer width — so the reassembled row views, masks, and
+    therefore logits are bit-identical to the contiguous program's."""
+    rope = _rope_tables(cfg)
+    S = tokens.shape[0]
+    lengths = lengths.astype(jnp.int32)
+    page_table = page_table.astype(jnp.int32)
+    positions = lengths[:, None]                       # (S, 1)
+    x = _embed(cfg, params, tokens, positions, None, True)
+    if blocks_list is None:
+        blocks_list = unstack_blocks(params, cfg)
+    adp_layers, head_node, head_s = _slot_adapter_layers(adapter, cfg)
+    use_paged_attn = _use_paged_attn(cache, cfg)
+
+    new = _new_cache_acc(cache)
+    for l, p in enumerate(blocks_list):
+        adp = adp_layers[l] if adp_layers is not None else None
+        h = _norm(cfg, p["norm1"], x)
+        q, k, v = _qkv_proj(cfg, p["attn"], h, rope, positions,
+                            adp=adp["attn"] if adp is not None else None)
+        _paged_append_kv(cache, new, l, k, v, lengths, page_table,
+                         cache_len)
+        if use_paged_attn:
+            from building_llm_from_scratch_tpu.ops.decode_step import (
+                paged_decode_attention,
+            )
+
+            out = paged_decode_attention(q, new["k"][l], new["v"][l],
+                                         page_table, lengths)
+        else:
+            K, V, scales = _paged_layer_kv(new, l, page_table, cache_len)
+            out = decode_attention(q, K, V, q_positions=positions,
+                                   kv_length=lengths + 1, **scales)
+        x = x + _attn_out_proj(p["attn"], out, S, 1,
+                               adp=adp["attn"] if adp is not None else None)
+        x = x + _mlp(cfg, p["mlp"], _norm(cfg, p["norm2"], x),
+                     adp=adp["mlp"] if adp is not None else None)
+    x = _norm(cfg, params["final_norm"], x)
+    logits = _head_logits(x, params["head"]["weight"], head_node, head_s)
+    return logits[:, 0], new
+
+
+def paged_verify_slots(params: Params, cfg: ModelConfig,
+                       tokens: jnp.ndarray, lengths: jnp.ndarray,
+                       page_table: jnp.ndarray, cache: Params,
+                       blocks_list: Optional[list] = None,
+                       adapter: Optional[Params] = None, *,
+                       cache_len: int) -> Tuple[jnp.ndarray, Params]:
+    """Paged sibling of ``verify_slots`` (Tq = k+1 speculative verify):
+    candidate k/v scatter at per-row logical offsets through the table,
+    rejected tails sit past ``kv_length`` exactly as before — masked
+    everywhere and overwritten by the next tick's append."""
+    rope = _rope_tables(cfg)
+    S, Tq = tokens.shape
+    lengths = lengths.astype(jnp.int32)
+    page_table = page_table.astype(jnp.int32)
+    positions = jnp.minimum(
+        lengths[:, None] + jnp.arange(Tq)[None, :],
+        cfg.context_length - 1)                                # (S, Tq)
+    x = _embed(cfg, params, tokens, positions, None, True)
+    if blocks_list is None:
+        blocks_list = unstack_blocks(params, cfg)
+    adp_layers, head_node, head_s = _slot_adapter_layers(adapter, cfg)
+
+    new = _new_cache_acc(cache)
+    for l, p in enumerate(blocks_list):
+        adp = adp_layers[l] if adp_layers is not None else None
+        h = _norm(cfg, p["norm1"], x)
+        q, k, v = _qkv_proj(cfg, p["attn"], h, rope, positions,
+                            adp=adp["attn"] if adp is not None else None)
+        _paged_append_kv(cache, new, l, k, v, lengths, page_table,
+                         cache_len)
+        K, V, scales = _paged_layer_kv(new, l, page_table, cache_len)
+        out = decode_attention(q, K, V, q_positions=positions,
+                               kv_length=lengths + Tq, **scales)
+        x = x + _attn_out_proj(p["attn"], out, S, Tq,
+                               adp=adp["attn"] if adp is not None else None)
+        x = x + _mlp(cfg, p["mlp"], _norm(cfg, p["norm2"], x),
+                     adp=adp["mlp"] if adp is not None else None)
+    x = _norm(cfg, params["final_norm"], x)
+    logits = _head_logits(x, params["head"]["weight"], head_node, head_s)
+    return logits, new
+
+
+def paged_prefill_chunk_into_slot(params: Params, cfg: ModelConfig,
+                                  tokens: jnp.ndarray,
+                                  chunk_start: jnp.ndarray,
+                                  prompt_len: jnp.ndarray,
+                                  slot: jnp.ndarray,
+                                  page_table: jnp.ndarray, cache: Params,
+                                  blocks_list: Optional[list] = None,
+                                  adapter: Optional[Params] = None, *,
+                                  cache_len: int
+                                  ) -> Tuple[jnp.ndarray, Params]:
+    """Paged sibling of ``prefill_chunk_into_slot``: the chunk's C
+    positions scatter into row ``slot``'s pages, and attention gathers
+    that one row's view through its table lane. Pad positions past the
+    prompt write zeros (the same determinism rule as contiguous); any
+    position past the row's allocated frontier lands on the trash page
+    — never read unmasked either way."""
+    _, C = tokens.shape
+    rope = _rope_tables(cfg)
+    positions = chunk_start + jnp.arange(C)
+    x = _embed(cfg, params, tokens, positions, None, True)
+    if blocks_list is None:
+        blocks_list = unstack_blocks(params, cfg)
+    adp_layers, head_node, head_s = _slot_adapter_layers(adapter, cfg)
+    valid = (positions < prompt_len)[None, :, None, None]
+    kv_len = jnp.reshape(jnp.minimum(chunk_start + C, prompt_len), (1,))
+    q_pos = positions[None, :]                       # (1, C) per-row form
+    page_table = page_table.astype(jnp.int32)
+    P = cache["k"][0].shape[2]
+    row_tab = jax.lax.dynamic_slice(
+        page_table, (slot, 0), (1, page_table.shape[1]))     # (1, M)
+    pos = jnp.minimum(positions, cache_len - 1)              # (C,)
+    phys = row_tab[0, pos // P]
+    off = pos % P
+    new = _new_cache_acc(cache)
+    for l, p in enumerate(blocks_list):
+        adp = adp_layers[l] if adp_layers is not None else None
+        h = _norm(cfg, p["norm1"], x)
+        q, k, v = _qkv_proj(cfg, p["attn"], h, rope, positions,
+                            adp=adp["attn"] if adp is not None else None)
+        k = jnp.where(valid, k, jnp.zeros((), k.dtype))
+        v = jnp.where(valid, v, jnp.zeros((), v.dtype))
+        _paged_scatter(cache, "k", k[0], phys, off, new)
+        _paged_scatter(cache, "v", v[0], phys, off, new)
+        K_row, V_row, scales = _paged_layer_kv(new, l, row_tab, cache_len)
+        out = decode_attention(q, K_row, V_row, q_positions=q_pos,
+                               kv_length=kv_len, **scales)
+        x = x + _attn_out_proj(p["attn"], out, 1, C,
+                               adp=adp["attn"] if adp is not None else None)
+        x = x + _mlp(cfg, p["mlp"], _norm(cfg, p["norm2"], x),
+                     adp=adp["mlp"] if adp is not None else None)
+    x = _norm(cfg, params["final_norm"], x)
+    idx = jnp.clip(prompt_len - 1 - chunk_start, 0, C - 1)
+    last = jax.lax.dynamic_slice(x, (0, idx, 0), (1, 1, x.shape[-1]))
+    logits = _head_logits(last, params["head"]["weight"], head_node, head_s)
+    return logits[0, 0], new

@@ -35,7 +35,13 @@ from typing import Any, Dict, FrozenSet, List
 
 #: Bump when a row type or a load-bearing field changes meaning. The
 #: ``header`` row carries it; consumers key parsing decisions on it.
-SCHEMA_VERSION = 11         # v11: memory observatory — memory_snapshot /
+SCHEMA_VERSION = 12         # v12: paged KV cache — page_admit /
+                            # page_share / page_release /
+                            # page_pool_exhausted events (serving page
+                            # pool: refcounted shared pages + page-table
+                            # attention), serve_warmup gains
+                            # kv_paged / page_tokens / pool_pages
+                            # (v11: memory observatory — memory_snapshot /
                             # memory_pressure / memory_drift events
                             # (obs/memory.py MemoryLedger: byte-exact
                             # component ledger + drift/pressure
@@ -292,6 +298,30 @@ _EVENT_LIST: List[EventSpec] = [
           optional=("span_tokens", "bytes", "entries", "adapter", "replica"),
           doc="a completed prefill's chunk-aligned prefix pane entered "
               "the store"),
+    # -- serving: paged KV (page pool + page-table attention) --------------
+    _spec("page_admit", required=("request_id",),
+          optional=("slot", "pages_reserved", "pool_free", "replica"),
+          doc="paged admission reserved the request's worst-case page "
+              "need from the pool (admission gates on free pages, not "
+              "free slots)"),
+    _spec("page_share", required=("request_id",),
+          optional=("slot", "n_pages", "span_tokens", "late", "pool_free",
+                    "replica"),
+          doc="a paged prefix hit: the slot's table now references the "
+              "stored entry's shared refcounted pages — zero pane-copy "
+              "bytes, zero forward FLOPs for the span"),
+    _spec("page_release", required=("slot",),
+          optional=("n_pages", "pages_freed", "pages_unreserved",
+                    "pool_free", "replica"),
+          doc="slot retirement decrefed its table columns (shared pages "
+              "survive under the store/co-sharers) and returned the "
+              "unused reservation to the pool"),
+    _spec("page_pool_exhausted", required=("request_id",),
+          optional=("pages_needed", "pages_available", "replica"),
+          doc="paged admission refused the queue head: the pool cannot "
+              "cover its worst-case need — the request re-queues at the "
+              "front and retries after the next release (one event per "
+              "exhaustion episode)"),
     # -- perf observatory -------------------------------------------------
     _spec("bench_result", required=("name",),
           optional=("metric", "value", "unit", "n_repeats", "quick",
@@ -305,7 +335,8 @@ _EVENT_LIST: List[EventSpec] = [
           optional=("n_prefill_buckets", "buckets", "seconds", "n_slots",
                     "max_len", "kv_quant", "prefix_cache", "prefill_chunk",
                     "kv_bytes_per_slot", "prefix_pane_tokens", "spec_k",
-                    "drafter", "replica"),
+                    "drafter", "replica", "kv_paged", "page_tokens",
+                    "pool_pages"),
           doc="prefill programs + decode (or spec verify) program "
               "compiled; watchers frozen; records the KVCachePolicy "
               "(quant/chunk/prefix) and the speculative config "

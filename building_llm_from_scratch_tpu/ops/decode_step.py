@@ -256,6 +256,129 @@ def supports_lora_shape(D: int, r: int, O: int) -> bool:
     return D % 128 == 0 and O % 128 == 0 and r % 8 == 0 and 8 <= r <= 256
 
 
+def _paged_kernel(tab_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                  mx_ref, d_ref, acc_ref, *, scale: float,
+                  page_tokens: int, max_pages: int):
+    """Online-softmax attention over one row's page list: grid cell
+    (s, m) DMAs physical page ``tab_ref[s * max_pages + m]`` — the
+    scalar-prefetched flattened page table drives the K/V BlockSpec
+    index maps, exactly the ``lora_bgmv`` gather discipline — and folds
+    its ``page_tokens`` positions into the running (max, denom, acc)
+    scratch. Initialized at m == 0, finalized into ``o_ref`` at the last
+    page. Mask: global position  m*P + p  <=  lengths[s]  (the
+    ``decode_attention`` Tq=1 causal rule); pages past the row's
+    frontier are all-masked, contributing exp(_NEG_BIG - max) == 0."""
+    s = pl.program_id(0)
+    m = pl.program_id(1)
+
+    @pl.when(m == 0)
+    def _init():
+        mx_ref[...] = jnp.full_like(mx_ref, _NEG_BIG)
+        d_ref[...] = jnp.zeros_like(d_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0]                                      # (Hkv, Rp, hd)
+    k = k_ref[0]                                      # (Hkv, P, hd)
+    v = v_ref[0]
+    Hkv, Rp, _ = q.shape
+    P = k.shape[1]
+    sc = jax.lax.dot_general(q, k, (((2,), (2,)), ((0,), (0,))),
+                             preferred_element_type=jnp.float32) * scale
+    pos = (m * page_tokens
+           + jax.lax.broadcasted_iota(jnp.int32, (Hkv, Rp, P), 2))
+    sc = jnp.where(pos <= len_ref[s], sc, _NEG_BIG)
+    m_new = jnp.maximum(mx_ref[...], jnp.max(sc, axis=-1, keepdims=True))
+    alpha = jnp.exp(mx_ref[...] - m_new)
+    p = jnp.exp(sc - m_new)
+    mx_ref[...] = m_new
+    d_ref[...] = d_ref[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+        p.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(m == max_pages - 1)
+    def _finalize():
+        o_ref[0] = (acc_ref[...] / d_ref[...]).astype(o_ref.dtype)
+
+
+def paged_decode_attention(q, k_pool, v_pool, page_table, lengths, *,
+                           interpret=False):
+    """Page-table attention for single-token decode: attend each slot's
+    logical row WITHOUT materializing it — grid cell (s, m) streams only
+    the physical page the row's table names, so HBM traffic is
+    O(tokens in flight), identical to the contiguous kernel's, while the
+    XLA reference path (``transformer._paged_view``) first gathers a
+    (S, Hkv, Tmax, hd) copy per layer.
+
+    q:          (S, 1, Hq, hd)  model layout, single token
+    k_pool:     (N, Hkv, P, hd) shared page pool (unquantized)
+    v_pool:     (N, Hkv, P, hd)
+    page_table: (S, M) int32 physical page per logical page (0 = trash)
+    lengths:    (S,) int32 valid prefix per row; attends kv_pos <=
+                lengths[s] (the new token's position, appended by the
+                caller BEFORE this kernel runs)
+
+    Returns (S, 1, Hq, hd) attention output. Page identity is DATA
+    (scalar-prefetched), so any table contents run through one compiled
+    program. ``interpret=True`` runs on CPU for parity tests."""
+    S, Tq, Hq, hd = q.shape
+    N, Hkv, P, _ = k_pool.shape
+    M = page_table.shape[1]
+    if Tq != 1:
+        raise ValueError(f"paged_decode_attention is single-token only; "
+                         f"Tq={Tq}")
+    G = Hq // Hkv
+    R = G * Tq
+    Rp = max(_MIN_ROWS, R)
+    qr = q.reshape(S, Tq, Hkv, G, hd).transpose(0, 2, 3, 1, 4)
+    qr = qr.reshape(S, Hkv, R, hd)
+    if Rp != R:
+        qr = jnp.pad(qr, ((0, 0), (0, 0), (0, Rp - R), (0, 0)))
+    tab = page_table.astype(jnp.int32).reshape(-1)
+    lens = jnp.asarray(lengths, jnp.int32)
+
+    def kv_idx(s, m, tab_ref, len_ref):
+        return (jnp.clip(tab_ref[s * M + m], 0, N - 1), 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S, M),
+        in_specs=[
+            pl.BlockSpec((1, Hkv, Rp, hd),
+                         lambda s, m, tab_ref, len_ref: (s, 0, 0, 0)),
+            pl.BlockSpec((1, Hkv, P, hd), kv_idx),
+            pl.BlockSpec((1, Hkv, P, hd), kv_idx),
+        ],
+        out_specs=pl.BlockSpec((1, Hkv, Rp, hd),
+                               lambda s, m, tab_ref, len_ref: (s, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((Hkv, Rp, 1), jnp.float32),
+            pltpu.VMEM((Hkv, Rp, 1), jnp.float32),
+            pltpu.VMEM((Hkv, Rp, hd), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_kernel, scale=1.0 / float(hd) ** 0.5,
+                          page_tokens=P, max_pages=M),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, Hkv, Rp, hd), q.dtype),
+        interpret=interpret,
+    )(tab, lens, qr, k_pool, v_pool)
+    out = out[:, :, :R]
+    out = out.reshape(S, Hkv, G, Tq, hd).transpose(0, 3, 1, 2, 4)
+    return out.reshape(S, Tq, Hq, hd)
+
+
+def supports_paged_shape(Tq: int, page_tokens: int, hd: int) -> bool:
+    """Paged-attention kernel eligibility: single-token decode,
+    lane-aligned head dim, sublane-aligned page length (each page is one
+    VMEM pane). Ineligible shapes — and int8 pools, gated off by the
+    caller exactly like ``supports_shape`` — keep the XLA gather
+    reference path."""
+    return (Tq == 1 and hd % 64 == 0 and hd <= 256
+            and page_tokens % 8 == 0)
+
+
 def supports_shape(Tq: int, Tmax: int, hd: int) -> bool:
     """Kernel eligibility: single-token decode, lane-aligned head dim,
     cache panes that fit VMEM comfortably, and 8-row-aligned Tmax (the

@@ -63,6 +63,9 @@ from building_llm_from_scratch_tpu.generate import (
 from building_llm_from_scratch_tpu.models.transformer import (
     decode_slots,
     init_slot_cache,
+    paged_decode_slots,
+    paged_prefill_chunk_into_slot,
+    paged_verify_slots,
     prefill_chunk_into_slot,
     prefill_into_slot,
     unstack_blocks,
@@ -83,7 +86,9 @@ from building_llm_from_scratch_tpu.obs.schema import TICK_PHASES
 from building_llm_from_scratch_tpu.serving.adapters import BASE_ADAPTER
 from building_llm_from_scratch_tpu.serving.kvcache import (
     KVCachePolicy,
+    PagePool,
     PrefixStore,
+    cache_nbytes,
     copy_prefix_into_slot,
     extract_prefix_panes,
 )
@@ -216,6 +221,49 @@ class DecodeEngine:
         #: and silently overwrite committed KV near capacity
         self._cache_len = self.max_len + self.spec_k
 
+        #: paged KV (``KVCachePolicy.paged``): slot rows map their
+        #: logical positions onto fixed-size pages of ONE shared pool
+        #: through a host-owned (n_slots, max_pages) int32 page table
+        #: that rides every compiled program as traced DATA (the
+        #: adapter-pool trick: identity is data, capacity is static) —
+        #: page churn (hits, frees, eviction, oversubscription) never
+        #: recompiles anything. Pool membership, refcounts and the
+        #: admission reservation are pure host bookkeeping (PagePool);
+        #: the device owns only the pool arrays.
+        self._paged = self.kv_policy.paged
+        self.page_pool: Optional[PagePool] = None
+        self._page_table: Optional[np.ndarray] = None
+        if self._paged:
+            if mesh_plan is not None:
+                raise ValueError(
+                    "paged KV cannot ride a tensor-parallel mesh plan "
+                    "yet: the pool leaves' (n_pages, ...) layout has no "
+                    "heads-sharded placement — run paged engines "
+                    "planless (replica-per-device fleets are fine)")
+            self._pages_per_slot = self.kv_policy.pages_per_slot(
+                self._cache_len)
+            self.page_pool = PagePool(
+                self.kv_policy.total_pool_pages(self.n_slots,
+                                                self._cache_len),
+                self.kv_policy.page_bytes(cfg))
+            self._page_table = np.zeros(
+                (self.n_slots, self._pages_per_slot),
+                np.int32)                               # guarded-by: _lock
+            #: table columns each slot has allocated (col 0 upward) and
+            #: the admission reservation still owed to it — invariant:
+            #: reserved[slot] == worst-case need − cols referenced
+            self._slot_cols = np.zeros(
+                (self.n_slots,), np.int32)              # guarded-by: _lock
+            self._pages_reserved = np.zeros(
+                (self.n_slots,), np.int32)              # guarded-by: _lock
+            # one page_pool_exhausted event per exhaustion episode (the
+            # head request would re-refuse every tick until pages free)
+            self._pool_exhausted_logged = False         # guarded-by: _lock
+        #: pane-copy spy: counts contiguous prefix-hit pane COPIES (the
+        #: duplicated-bytes path paged mode deletes) — a paged engine
+        #: must hold this at zero (bench + CI assert it)
+        self.pane_copies = 0                            # guarded-by: _lock
+
         self.queue = RequestQueue(max_queue)
         self.scheduler = Scheduler(self.n_slots)
         self.cache = self._place_cache(init_slot_cache(
@@ -256,7 +304,8 @@ class DecodeEngine:
                 adapter_fingerprint(cfg),
                 chunk_tokens=self.kv_policy.prefill_chunk,
                 budget_bytes=self.kv_policy.prefix_budget_bytes,
-                pane_tokens=self._prefix_pane_len)
+                pane_tokens=self._prefix_pane_len,
+                page_pool=self.page_pool)
 
         S = self.n_slots
         # host-owned per-slot state; the device owns only the big k/v.
@@ -295,7 +344,13 @@ class DecodeEngine:
         import functools
 
         prefill_jit = jax.jit(self._prefill_impl, donate_argnums=(0,))
-        chunk_jit = jax.jit(self._chunk_impl, donate_argnums=(0,))
+        # paged: the chunk/step programs take the page table as one more
+        # traced argument and write/read through it; the monolithic
+        # prefill and the prefix copy/extract pair are never CALLED
+        # (paged implies chunked prefill, and a paged hit is a host
+        # table write) — they stay built so the watcher set is stable
+        chunk_jit = jax.jit(self._paged_chunk_impl if self._paged
+                            else self._chunk_impl, donate_argnums=(0,))
         copy_jit = jax.jit(self._copy_impl, donate_argnums=(0,))
         extract_jit = jax.jit(functools.partial(
             extract_prefix_panes, pane_len=self._prefix_pane_len))
@@ -303,8 +358,13 @@ class DecodeEngine:
         # plain decode step is never built (every slot, spec-opted-out
         # rows included, rides verify; their commit count is clamped to 1
         # on the host). spec off: the historical decode step, untouched.
-        step_jit = jax.jit(self._verify_impl if self.spec_k
-                           else self._decode_impl, donate_argnums=(0,))
+        if self._paged:
+            step_impl = (self._paged_verify_impl if self.spec_k
+                         else self._paged_decode_impl)
+        else:
+            step_impl = (self._verify_impl if self.spec_k
+                         else self._decode_impl)
+        step_jit = jax.jit(step_impl, donate_argnums=(0,))
         step_label = "serve_verify" if self.spec_k else "serve_decode"
         if watch_compiles:
             self._prefill = CompileWatcher(prefill_jit,
@@ -467,24 +527,42 @@ class DecodeEngine:
                         lambda: pytree_nbytes(self.params))
         bps = self.kv_policy.bytes_per_slot(self.cfg, self.max_len)
         n = self.n_slots
-        ledger.register("slot_kv",
-                        lambda: self._cache_component_bytes()[0],
-                        expected=lambda: bps["kv_bytes"] * n)
-        if bps["scale_bytes"]:
-            ledger.register("kv_scales",
-                            lambda: self._cache_component_bytes()[1],
-                            expected=lambda: bps["scale_bytes"] * n)
-        if self.spec_k:
-            bps_full = self.kv_policy.bytes_per_slot(self.cfg,
-                                                     self._cache_len)
+        if self._paged:
+            # the pool IS the KV allocation: one component, byte-exact
+            # by construction (every leaf is n_pages x one page's slice,
+            # so measured == total_pool_pages x page_bytes, always —
+            # any gap means the pool arrays were rebuilt wrong).
+            # Providers read self.page_pool dynamically: a restart swaps
+            # in a fresh pool and the next snapshot follows it.
             ledger.register(
-                "spec_headroom",
-                lambda: self._cache_component_bytes()[2],
-                expected=lambda: (bps_full["total_bytes"]
-                                  - bps["total_bytes"]) * n)
+                "page_pool",
+                lambda: cache_nbytes(self.cache),  # graft-ok: GL031 nbytes metadata, runs at ledger cadence under the engine lock
+                expected=lambda: (self.page_pool.n_pages
+                                  * self.page_pool.page_bytes))
+        else:
+            ledger.register("slot_kv",
+                            lambda: self._cache_component_bytes()[0],
+                            expected=lambda: bps["kv_bytes"] * n)
+            if bps["scale_bytes"]:
+                ledger.register("kv_scales",
+                                lambda: self._cache_component_bytes()[1],
+                                expected=lambda: bps["scale_bytes"] * n)
+            if self.spec_k:
+                bps_full = self.kv_policy.bytes_per_slot(self.cfg,
+                                                         self._cache_len)
+                ledger.register(
+                    "spec_headroom",
+                    lambda: self._cache_component_bytes()[2],
+                    expected=lambda: (bps_full["total_bytes"]
+                                      - bps["total_bytes"]) * n)
         if self.prefix_store is not None:
             store = self.prefix_store
-            ledger.register("prefix_store", lambda: store.bytes_total)
+            # paged: stored entries hold REFERENCES to pool pages — the
+            # bytes already live inside the page_pool component, so the
+            # store series is attribution only (device=False keeps it
+            # out of the pressure/headroom device sum: no double count)
+            ledger.register("prefix_store", lambda: store.bytes_total,
+                            device=not self._paged)
             ledger.register_labeled("prefix_store_bytes", "namespace",
                                     store.bytes_by_tag)
             ledger.register_probe("prefix_store",
@@ -536,6 +614,15 @@ class DecodeEngine:
         out: dict = {}
         for slot, req in self.scheduler.active():
             nm = req.params.adapter or BASE_ADAPTER
+            if self._paged:
+                # page-exact: mapped columns x page bytes. A shared page
+                # is charged to EVERY sharer (attribution answers "who
+                # depends on this memory", not "who allocated it"), so
+                # the tenant sum can exceed pool-used — by design
+                cols = int(self._slot_cols[slot])  # graft-ok: GL011 host numpy
+                out[nm] = (out.get(nm, 0)
+                           + cols * self.page_pool.page_bytes)
+                continue
             live = int(self._lengths[slot])  # graft-ok: GL011 host numpy
             out[nm] = out.get(nm, 0) + live * self._kv_bytes_per_token
         return out
@@ -650,6 +737,76 @@ class DecodeEngine:
         logits, cache = verify_slots(
             self.params, self.cfg, tokens, lengths, cache, self._blocks,
             adapter=adapter)
+        Tq = tokens.shape[1]
+        offsets = n_gen[:, None] + jnp.arange(Tq)[None, :]     # (S, Tq)
+        keys = jax.vmap(jax.vmap(token_rng, in_axes=(None, 0)))(
+            base_keys, offsets)
+        toks, n_acc, ok = accept_draft_tokens(
+            logits, tokens[:, 1:], keys, temps, topks, self.max_top_k)
+        return toks, n_acc, ok, self._pin_cache(cache)
+
+    # -- paged variants: identical sampling/accept tails, but the KV
+    # cache is the shared page pool and a per-slot int32 page table rides
+    # each call as TRACED DATA (one (S, max_pages) signature — page churn
+    # never recompiles, mirroring the adapter-pool trick) ----------------
+
+    def _paged_chunk_impl(self, cache, tokens, chunk_start, prompt_len,
+                          slot, page_table, base_key, temp, topk,
+                          pool=None, pool_scale=None, adapter_id=None):
+        import jax.numpy as jnp
+
+        adapter = None
+        if pool is not None:
+            adapter = {"pool": pool, "scaling": pool_scale,
+                       "ids": jnp.reshape(adapter_id, (1,))}
+        logits, cache = paged_prefill_chunk_into_slot(
+            self.params, self.cfg, tokens, chunk_start, prompt_len, slot,
+            page_table, cache, self._blocks, adapter=adapter,
+            cache_len=self._cache_len)
+        key0 = token_rng(base_key, 0)
+        tok = sample_tokens_dynamic(
+            logits[None], key0[None], jnp.reshape(temp, (1,)),
+            jnp.reshape(topk, (1,)), self.max_top_k)[0]
+        ok = jnp.all(jnp.isfinite(logits))
+        return tok, ok, self._pin_cache(cache)
+
+    def _paged_decode_impl(self, cache, tokens, lengths, page_table,
+                           base_keys, n_gen, temps, topks, pool=None,
+                           pool_scale=None, adapter_ids=None):
+        import jax
+        import jax.numpy as jnp
+
+        adapter = None
+        if pool is not None:
+            adapter = {"pool": pool, "scaling": pool_scale,
+                       "ids": adapter_ids}
+        logits, cache = paged_decode_slots(
+            self.params, self.cfg, tokens[:, None], lengths, page_table,
+            cache, self._blocks, adapter=adapter,
+            cache_len=self._cache_len)
+        keys = jax.vmap(token_rng)(base_keys, n_gen)
+        nxt = sample_tokens_dynamic(logits, keys, temps, topks,
+                                    self.max_top_k)
+        ok = jnp.all(jnp.isfinite(logits), axis=-1)
+        return nxt, ok, self._pin_cache(cache)
+
+    def _paged_verify_impl(self, cache, tokens, lengths, page_table,
+                           base_keys, n_gen, temps, topks, pool=None,
+                           pool_scale=None, adapter_ids=None):
+        import jax
+        import jax.numpy as jnp
+
+        from building_llm_from_scratch_tpu.generate import (
+            accept_draft_tokens,
+        )
+
+        adapter = None
+        if pool is not None:
+            adapter = {"pool": pool, "scaling": pool_scale,
+                       "ids": adapter_ids}
+        logits, cache = paged_verify_slots(
+            self.params, self.cfg, tokens, lengths, page_table, cache,
+            self._blocks, adapter=adapter, cache_len=self._cache_len)
         Tq = tokens.shape[1]
         offsets = n_gen[:, None] + jnp.arange(Tq)[None, :]     # (S, Tq)
         keys = jax.vmap(jax.vmap(token_rng, in_axes=(None, 0)))(
@@ -1180,6 +1337,9 @@ class DecodeEngine:
         ``prev_pos`` is the slot's already-prefilled position then, so
         the request's ``prefix_bytes_saved`` ledger counts only the NEW
         tokens the copy spared it from recomputing."""
+        if self._paged:
+            return self._apply_paged_hit(slot, req, gen, span, entry,
+                                         late, prev_pos)
         t_cp = time.perf_counter()
         try:
             cache = self._prefix_copy(self.cache, entry.panes,
@@ -1189,6 +1349,7 @@ class DecodeEngine:
         if self._generation != gen:
             return False
         self.cache = cache
+        self.pane_copies += 1   # spy: paged mode asserts this stays 0
         self._window_prefix_hits += 1
         self._tick_add("prefix_copy", time.perf_counter() - t_cp)
         # the exact quantity ROADMAP item 1 (paged KV) optimizes: KV
@@ -1202,6 +1363,55 @@ class DecodeEngine:
             n_suffix_chunks=-(-(Tp - span)
                               // self.kv_policy.prefill_chunk),
             adapter=req.params.adapter)
+        return True
+
+    # holds: _lock
+    def _apply_paged_hit(self, slot: int, req: Request, gen: int,
+                         span: int, entry, late: bool,
+                         prev_pos: int = 0) -> bool:
+        """Paged prefix HIT: a host page-table write. The slot's leading
+        columns point at the entry's SHARED refcounted pages — no device
+        program, no copy, zero FLOPs/bytes for the cached span (the
+        whole point of the page table). Incref FIRST, then retire the
+        slot's old columns: a late hit's entry may share physical pages
+        with the columns being replaced (a sharer stored a longer pane
+        over the same prefix), and incref-before-decref keeps those
+        pages alive through the swap."""
+        pages = entry.pages
+        try:
+            for p in pages:
+                self.page_pool.incref(p)
+        finally:
+            self.prefix_store.release(entry)
+        old_cols = int(self._slot_cols[slot])  # graft-ok: GL011 host numpy
+        old = [int(p)                          # graft-ok: GL011 host numpy
+               for p in self._page_table[slot, :old_cols]]
+        n_new = len(pages)          # == span // page_tokens, by insert
+        self._page_table[slot, :n_new] = pages
+        self._slot_cols[slot] = n_new
+        for p in old:
+            self.page_pool.decref(p)
+        # refund the reservation for every column the share just covered:
+        # admission reserved the full worst-case need assuming NO hit;
+        # shared columns will never draw a fresh page
+        refund = min(n_new - old_cols,
+                     int(self._pages_reserved[slot]))  # graft-ok: GL011 host numpy
+        if refund > 0:
+            self.page_pool.unreserve(refund)
+            self._pages_reserved[slot] -= refund
+        self._window_prefix_hits += 1
+        req.prefix_bytes_saved += ((span - prev_pos)
+                                   * self._kv_bytes_per_token)
+        Tp = int(req.prompt_ids.size)   # graft-ok: GL011 host numpy size
+        self._ev(
+            "prefix_hit", request_id=req.id, span_tokens=span,
+            prompt_tokens=Tp, key=entry.key, late=late,
+            n_suffix_chunks=-(-(Tp - span)
+                              // self.kv_policy.prefill_chunk),
+            adapter=req.params.adapter)
+        self._ev("page_share", request_id=req.id, slot=slot,
+                 n_pages=n_new, span_tokens=span, late=late,
+                 pool_free=self.page_pool.n_free)
         return True
 
     # holds: _lock
@@ -1243,9 +1453,15 @@ class DecodeEngine:
             hi = min(lo + C, Tp)
             chunk = np.zeros((1, C), np.int32)
             chunk[0, : hi - lo] = req.prompt_ids[lo:hi]
+            if self._paged:
+                # back the chunk's real columns with pages; the pad
+                # tail's columns stay unmapped and scatter into trash
+                self._ensure_pages(slot, hi)
             tok, ok, cache = self._prefill_chunk(
                 self.cache, chunk, np.int32(lo), np.int32(Tp),
-                np.int32(slot), st["base_key"], st["temp"], st["topk"],
+                np.int32(slot),
+                *((self._page_table,) if self._paged else ()),
+                st["base_key"], st["temp"], st["topk"],
                 *self._pool_args_for(st["adapter_row"]))
             if self._generation != gen:
                 return False        # abandoned mid-chunk: commit nothing
@@ -1307,6 +1523,23 @@ class DecodeEngine:
         prefix_ids = req.prompt_ids[:span]
         if self.prefix_store.contains(prefix_ids, tag):
             return
+        if self._paged:
+            # paged store = publish the slot's OWN leading pages under
+            # the key (the store increfs them) — no extract program, no
+            # copy, no new bytes allocated. span is chunk-aligned and
+            # C % P == 0, so the span covers whole pages exactly.
+            n_cols = span // self.kv_policy.page_tokens
+            pages = [int(p)                    # graft-ok: GL011 host numpy
+                     for p in self._page_table[slot, :n_cols]]
+            nbytes = self.prefix_store.insert_pages(prefix_ids, tag,
+                                                    pages)
+            if nbytes:
+                self._ev(
+                    "prefix_insert", request_id=req.id,
+                    span_tokens=span, bytes=nbytes,
+                    entries=self.prefix_store.n_entries,
+                    adapter=req.params.adapter)
+            return
         t_ex = time.perf_counter()
         panes = self._prefix_extract(self.cache, np.int32(slot),
                                      np.int32(span))
@@ -1328,8 +1561,17 @@ class DecodeEngine:
         same shapes, zero recompiles, co-resident rows untouched (their
         attention never reads another slot's rows). int8 caches poison
         through the FLOAT leaves (the scale sidecars): int8 codes can't
-        hold NaN, but a NaN scale makes every dequantized value NaN."""
+        hold NaN, but a NaN scale makes every dequantized value NaN.
+
+        Paged: NaN only the slot's PRIVATE pages (refcount 1). Shared
+        pages belong to other tenants too — poisoning them would fail
+        innocent co-sharers, which the contiguous fault model (slot
+        isolation) never does."""
         import jax.numpy as jnp
+
+        if self._paged:
+            self._rewrite_slot_pages(slot, np.nan)
+            return
 
         def nan_row(layer):
             if not jnp.issubdtype(layer.dtype, jnp.floating):
@@ -1346,6 +1588,129 @@ class DecodeEngine:
             return jnp.asarray(host)
 
         self.cache = {name: [nan_row(buf) for buf in bufs]
+                      for name, bufs in self.cache.items()}
+
+    # -- paged page accounting (host bookkeeping; the jitted programs
+    # only ever see the resulting table as traced data) -------------------
+
+    # holds: _lock
+    def _page_need(self, req: Request) -> int:
+        """Worst-case page count for one request: whole prompt plus
+        max_new_tokens plus spec headroom, capped at the slot window."""
+        Tp = int(req.prompt_ids.size)   # graft-ok: GL011 host numpy size
+        toks = min(Tp + req.params.max_new_tokens + self.spec_k,
+                   self._cache_len)
+        return -(-toks // self.kv_policy.page_tokens)
+
+    # holds: _lock
+    def _admit_pages(self, slot: int, req: Request) -> bool:
+        """Paged admission gate: reserve the request's WORST-CASE page
+        need up front — admission checks free pages, not free slots.
+        Refusal is the oversubscription policy made explicit: the
+        request bounces back to the queue head and waits for a
+        retirement, instead of deadlocking mid-decode on a dry pool. A
+        later prefix hit refunds the shared columns' reservation."""
+        if not self._paged:
+            return True
+        need = self._page_need(req)
+        pool = self.page_pool
+        if need > pool.n_pages - 1:
+            # can NEVER fit (worst case exceeds the whole usable pool):
+            # bouncing would livelock the queue head — fail it loudly,
+            # like an over-long prompt. Returns None so the admission
+            # loop skips _admit (the slot was already freed here).
+            self._fail_request(
+                slot, req,
+                f"request needs up to {need} KV pages but the pool "
+                f"holds {pool.n_pages - 1}: shorten the request or "
+                "size pool_pages for at least one worst-case request",
+                reason="page_pool_too_small")
+            return None
+        if pool.available() < need:
+            if not self._pool_exhausted_logged:
+                # one-shot per exhaustion episode (cleared when a slot
+                # next returns pages) — steady-state refusals must not
+                # spam the event log
+                self._pool_exhausted_logged = True
+                self._ev("page_pool_exhausted", request_id=req.id,
+                         pages_needed=need,
+                         pages_available=pool.available())
+            return False
+        pool.reserve(need)
+        self._pages_reserved[slot] = need
+        self._ev("page_admit", request_id=req.id, slot=slot,
+                 pages_reserved=need, pool_free=pool.n_free)
+        return True
+
+    # graft: hot-path
+    # holds: _lock
+    def _ensure_pages(self, slot: int, n_tokens: int) -> None:
+        """Map enough table columns for ``n_tokens`` tokens, drawing
+        from this slot's admission reservation (never from the open
+        pool — reserving at admission is what makes mid-flight
+        exhaustion impossible). Host numpy + integer bookkeeping only;
+        unmapped columns stay 0 = the pinned trash page."""
+        P = self.kv_policy.page_tokens
+        cols = int(self._slot_cols[slot])   # graft-ok: GL011 host numpy
+        want = -(-min(n_tokens, self._cache_len) // P)
+        want = min(want, self._pages_per_slot,
+                   cols + int(self._pages_reserved[slot]))  # graft-ok: GL011 host numpy
+        while cols < want:
+            page = self.page_pool.alloc(from_reserved=True)
+            self._pages_reserved[slot] -= 1
+            self._page_table[slot, cols] = page
+            cols += 1
+        self._slot_cols[slot] = cols
+
+    # holds: _lock
+    def _release_slot_pages(self, slot: int) -> None:
+        """Retire/cancel/fail: decref every mapped column (pages shared
+        with the prefix store or co-sharers survive; private ones return
+        to the pool) and hand back the unused reservation — live
+        capacity is bounded by tokens in flight, not n_slots x Tmax."""
+        cols = int(self._slot_cols[slot])      # graft-ok: GL011 host numpy
+        freed = 0
+        for col in range(cols):
+            if self.page_pool.decref(
+                    int(self._page_table[slot, col])):  # graft-ok: GL011 host numpy
+                freed += 1
+        reserved = int(self._pages_reserved[slot])  # graft-ok: GL011 host numpy
+        if reserved:
+            self.page_pool.unreserve(reserved)
+        self._page_table[slot, :] = 0
+        self._slot_cols[slot] = 0
+        self._pages_reserved[slot] = 0
+        self._pool_exhausted_logged = False
+        self._ev("page_release", slot=slot, n_pages=cols,
+                 pages_freed=freed, pages_unreserved=reserved,
+                 pool_free=self.page_pool.n_free)
+
+    # holds: _lock
+    def _rewrite_slot_pages(self, slot: int, value: float) -> None:
+        """Host-rewrite the FLOAT leaves of the slot's PRIVATE pages
+        (refcount 1; shared pages belong to co-sharers too). value=NaN
+        is the fault-injection poison; value=0.0 is the recycling scrub:
+        pool pages are read by every slot's gather, so a freed page
+        still carrying NaN would re-enter the pool and poison whichever
+        slot draws it next (masked attention weights are exactly 0.0,
+        and 0.0 x NaN = NaN straight through the softmax) — a cross-slot
+        blast radius the contiguous layout never had."""
+        import jax.numpy as jnp
+
+        mine = [int(p)
+                for p in self._page_table[slot, :self._slot_cols[slot]]
+                if int(p) != 0 and self.page_pool.refcount(int(p)) == 1]
+        if not mine:
+            return
+
+        def rewrite(buf):
+            if not jnp.issubdtype(buf.dtype, jnp.floating):
+                return buf      # int8 codes: NaN rides the float scales
+            host = np.asarray(buf).copy()
+            host[mine] = value
+            return jnp.asarray(host)
+
+        self.cache = {name: [rewrite(buf) for buf in bufs]
                       for name, bufs in self.cache.items()}
 
     # -- tracing / tick accounting ----------------------------------------
@@ -1424,11 +1789,30 @@ class DecodeEngine:
             while True:
                 admitted = self.scheduler.admit_from(
                     self.queue, skip=self._admission_skip)
-                for slot, req in admitted:
+                bounced = None
+                for i, (slot, req) in enumerate(admitted):
+                    # paged oversubscription: admission is gated on FREE
+                    # PAGES (this request's worst-case need), not free
+                    # slots — a slot with no backing memory must not run
+                    ok = self._admit_pages(slot, req)
+                    if ok is None:
+                        continue  # failed permanently (slot already freed)
+                    if not ok:
+                        bounced = i
+                        break
                     self._admit(slot, req, gen)
                     if self._generation != gen:
                         self._book_tick_wall(t_tick0)
                         return False
+                if bounced is not None:
+                    # hand the refused head — and everything admit_from
+                    # popped behind it — back to the queue in reverse, so
+                    # FCFS order survives the bounce; retry next tick
+                    # once retirements have returned pages to the pool
+                    for slot, req in reversed(admitted[bounced:]):
+                        self.scheduler.retire(slot)
+                        self.queue.put_front(req)
+                    break
                 if not admitted:
                     break
             # client cancellations retire at the tick boundary: the slot
@@ -1478,10 +1862,19 @@ class DecodeEngine:
                 # speculative tick: draft k per slot, ONE verify forward,
                 # multi-token commit (serving/spec.py + _verify_tick)
                 return self._verify_tick(decoding, gen, t_tick0)
+            if self._paged:
+                # grow each decoding slot's table BEFORE dispatch: the
+                # append lands at column lengths//P, which must point at
+                # a real page (mid-prefill rows ride as ignored garbage
+                # into the pinned trash page — no allocation for them)
+                for slot, _req in decoding:
+                    self._ensure_pages(
+                        slot, int(self._lengths[slot]) + 1)  # graft-ok: GL011 host numpy
             t_dec = time.perf_counter()
             nxt, ok, cache = self._decode(
-                self.cache, self._last_tokens,
-                self._lengths, self._base_keys, self._n_gen, self._temps,
+                self.cache, self._last_tokens, self._lengths,
+                *((self._page_table,) if self._paged else ()),
+                self._base_keys, self._n_gen, self._temps,
                 self._topks, *(self._pool_args() + (self._adapter_ids,)
                                if self.adapters is not None else ()))
             self._tick_add("decode_dispatch", time.perf_counter() - t_dec)
@@ -1560,10 +1953,18 @@ class DecodeEngine:
         tokens_in = np.concatenate(
             [self._last_tokens[:, None], drafts], axis=1)
         self._tick_add("draft", time.perf_counter() - t_draft)
+        if self._paged:
+            # verify appends k+1 candidates at lengths..lengths+k; the
+            # spec headroom (_cache_len = max_len + spec_k) guarantees
+            # those columns exist for decoding rows
+            for slot, _req in decoding:
+                self._ensure_pages(
+                    slot, int(self._lengths[slot]) + 1 + k)  # graft-ok: GL011 host numpy
         t_dec = time.perf_counter()
         toks, n_acc, ok, cache = self._verify(
-            self.cache, tokens_in, self._lengths, self._base_keys,
-            self._n_gen, self._temps, self._topks,
+            self.cache, tokens_in, self._lengths,
+            *((self._page_table,) if self._paged else ()),
+            self._base_keys, self._n_gen, self._temps, self._topks,
             *(self._pool_args() + (self._adapter_ids,)
               if self.adapters is not None else ()))
         self._tick_add("decode_dispatch", time.perf_counter() - t_dec)
@@ -1701,6 +2102,8 @@ class DecodeEngine:
 
     # holds: _lock
     def _free_slot(self, slot: int) -> None:
+        if self._paged:
+            self._release_slot_pages(slot)
         self.scheduler.retire(slot)
         self._prefill_state.pop(slot, None)    # mid-prefill retirement
         self._lengths[slot] = 0
@@ -1729,6 +2132,12 @@ class DecodeEngine:
         the machine-readable ``reason`` — the engine itself keeps serving.
         """
         if slot is not None and self.scheduler.slots[slot] is req:
+            if self._paged and reason == "non_finite_logits":
+                # scrub the failed slot's private pages to zero BEFORE
+                # they return to the pool: unlike the contiguous layout,
+                # freed pages are recycled into other slots, and a NaN
+                # KV value reads through masked attention (0.0 x NaN)
+                self._rewrite_slot_pages(slot, 0.0)
             self._free_slot(slot)
         req.error = msg
         req.finish_reason = finish
@@ -1876,12 +2285,19 @@ class DecodeEngine:
                 buckets = [self.kv_policy.prefill_chunk]
                 dummy = np.zeros((1, self.kv_policy.prefill_chunk),
                                  np.int32)
+                # paged: the warmup table is ALL ZEROS — every scatter/
+                # gather rides the pinned trash page, so warming compiles
+                # the real programs without allocating a single page
                 tok, _ok, cache = self._prefill_chunk(
                     self.cache, dummy, np.int32(0), np.int32(1),
-                    np.int32(0), zero_key, np.float32(0.0), np.int32(0),
+                    np.int32(0),
+                    *((self._page_table,) if self._paged else ()),
+                    zero_key, np.float32(0.0), np.int32(0),
                     *self._pool_args_for(np.int32(-1)))
                 self.cache = cache
-                if self.prefix_store is not None:
+                if self.prefix_store is not None and not self._paged:
+                    # paged hit/store are host table writes — the copy/
+                    # extract programs exist but are never dispatched
                     panes = self._prefix_extract(self.cache, np.int32(0),
                                                  np.int32(1))
                     self.cache = self._prefix_copy(self.cache, panes,
@@ -1903,14 +2319,16 @@ class DecodeEngine:
                                        np.int32)
                 nxt, _n_acc, _ok, cache = self._verify(
                     self.cache, warm_tokens, self._lengths,
+                    *((self._page_table,) if self._paged else ()),
                     self._base_keys, self._n_gen, self._temps,
                     self._topks, *(self._pool_args()
                                    + (self._adapter_ids,)
                                    if self.adapters is not None else ()))
             else:
                 nxt, _ok, cache = self._decode(
-                    self.cache, self._last_tokens,
-                    self._lengths, self._base_keys, self._n_gen,
+                    self.cache, self._last_tokens, self._lengths,
+                    *((self._page_table,) if self._paged else ()),
+                    self._base_keys, self._n_gen,
                     self._temps, self._topks,
                     *(self._pool_args() + (self._adapter_ids,)
                       if self.adapters is not None else ()))
@@ -1933,6 +2351,11 @@ class DecodeEngine:
         spec_fields = ({"spec_k": self.spec_k,
                         "drafter": self.drafter.describe()}
                        if self.spec_k else {})
+        kv_fields = self.kv_policy.describe()
+        if self._paged:
+            # the RESOLVED usable pool (policy.pool_pages=0 means "sized
+            # to n_slots full rows" — report what was actually built)
+            kv_fields["pool_pages"] = self.page_pool.n_pages - 1
         self._ev(
             "serve_warmup", n_prefill_buckets=len(buckets),
             buckets=buckets, seconds=round(time.monotonic() - t0, 3),
@@ -1941,7 +2364,7 @@ class DecodeEngine:
             prefix_pane_tokens=(self._prefix_pane_len
                                 if self.prefix_store is not None
                                 else None),
-            **self.kv_policy.describe(), **spec_fields)
+            **kv_fields, **spec_fields)
         logger.info(
             "Serving warmup: %s + 1 %s program in %.2fs (kv %s, "
             "%.2f MiB/slot%s%s)",
@@ -2061,11 +2484,28 @@ class DecodeEngine:
                 # the old cache may be donation-poisoned or numerically
                 # corrupt; a fresh one has identical shapes/dtypes, so the
                 # frozen compiled programs accept it without recompiling.
-                # The prefix store survives: its panes are independent
-                # device arrays a wedged tick can't have corrupted.
+                # Contiguous: the prefix store survives — its panes are
+                # independent device arrays a wedged tick can't have
+                # corrupted. Paged: stored entries REFERENCE the pool
+                # being thrown away, so the store is cleared and the pool
+                # rebuilt from scratch alongside the cache (the ledger's
+                # providers read self.page_pool and follow the swap).
                 self.cache = self._place_cache(init_slot_cache(
                     self.cfg, self.n_slots, self._cache_len,
                     policy=self.kv_policy))
+                if self._paged:
+                    if self.prefix_store is not None:
+                        self.prefix_store.clear()
+                    self.page_pool = PagePool(
+                        self.kv_policy.total_pool_pages(self.n_slots,
+                                                        self._cache_len),
+                        self.kv_policy.page_bytes(self.cfg))
+                    if self.prefix_store is not None:
+                        self.prefix_store.page_pool = self.page_pool
+                    self._page_table[:] = 0
+                    self._slot_cols[:] = 0
+                    self._pages_reserved[:] = 0
+                    self._pool_exhausted_logged = False
             backoff = self.restart_backoff_s * (2.0 ** (n_restart - 1))
             self._ev(
                 "engine_restart", reason=reason, detail=detail,
@@ -2317,6 +2757,9 @@ class DecodeEngine:
                 out["adapters_loaded"] = self.adapters.n_loaded
             out["kv_policy"] = self.kv_policy.describe()
             out["memory"] = self.memory_ledger.describe()
+            if self._paged:
+                out["page_pool"] = self.page_pool.stats()
+                out["pane_copies"] = self.pane_copies
             if self.prefix_store is not None:
                 out["prefix_store"] = self.prefix_store.stats()
             slo = self.slo_window.ratio()
@@ -2409,6 +2852,14 @@ class DecodeEngine:
             # hit-ratio is the prefix cache's scoreboard
             gauges["kv_bytes_per_slot"] = self.kv_policy.bytes_per_slot(
                 self.cfg, self._cache_len)["total_bytes"]
+            if self._paged:
+                ps = self.page_pool.stats()
+                gauges["kv_pages_total"] = ps["n_pages"]
+                gauges["kv_pages_used"] = ps["used"]
+                gauges["kv_pages_free"] = ps["free"]
+                gauges["kv_pages_reserved"] = ps["reserved"]
+                gauges["kv_pages_peak_used"] = ps["peak_used"]
+                gauges["kv_page_bytes"] = ps["page_bytes"]
             if self.spec_k:
                 # acceptance ratio is THE drafter-quality dial: low ratio
                 # means the verify widths are wasted compute — shrink k

@@ -341,17 +341,20 @@ def run_serve(args, comps, metric_logger) -> DecodeEngine:
     from building_llm_from_scratch_tpu.serving.kvcache import KVCachePolicy
 
     prefix_on = getattr(args, "serve_prefix_cache", "off") == "on"
+    paged_on = getattr(args, "serve_kv_paged", "off") == "on"
     chunk = getattr(args, "serve_prefill_chunk", 0)
-    if prefix_on and chunk <= 0:
-        chunk = 64          # prefix caching implies chunked prefill
-        logger.info("--serve_prefix_cache on: defaulting "
-                    "--serve_prefill_chunk to 64.")
+    if (prefix_on or paged_on) and chunk <= 0:
+        chunk = 64          # prefix caching/paging imply chunked prefill
+        logger.info("--serve_%s on: defaulting --serve_prefill_chunk "
+                    "to 64.", "prefix_cache" if prefix_on else "kv_paged")
     kv_policy = KVCachePolicy(
         kv_quant=getattr(args, "serve_kv_quant", "model"),
         prefix_cache=prefix_on,
         prefill_chunk=chunk,
         prefix_budget_bytes=int(
             getattr(args, "serve_prefix_budget_mb", 256.0) * 1024 ** 2),
+        paged=paged_on,
+        page_tokens=getattr(args, "serve_kv_page_tokens", 16),
     )
     n_replicas = getattr(args, "serve_replicas", 1)
     serve_tp = getattr(args, "serve_tp", 1)
@@ -393,7 +396,9 @@ def run_serve(args, comps, metric_logger) -> DecodeEngine:
                 kv_quant=kv_policy.kv_quant,
                 prefix_cache=kv_policy.prefix_cache,
                 prefill_chunk=kv_policy.prefill_chunk,
-                prefix_budget_bytes=kv_policy.prefix_budget_bytes),
+                prefix_budget_bytes=kv_policy.prefix_budget_bytes,
+                paged=kv_policy.paged,
+                page_tokens=kv_policy.page_tokens),
             adapters=adapter_paths,
             spec_k=getattr(args, "serve_spec_k", 0),
         )

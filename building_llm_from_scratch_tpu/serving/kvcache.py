@@ -88,6 +88,9 @@ class KVCachePolicy:
     prefix_cache: bool = False
     prefill_chunk: int = 0           # 0 = monolithic bucketed prefill
     prefix_budget_bytes: int = 256 * 1024 ** 2
+    paged: bool = False              # page-table layout over a shared pool
+    page_tokens: int = 16            # positions per KV page (paged only)
+    pool_pages: int = 0              # usable pool pages; 0 = n_slots full
 
     def __post_init__(self):
         if self.kv_quant not in KV_QUANT_CHOICES:
@@ -103,6 +106,25 @@ class KVCachePolicy:
                 "monolithic bucketed prefill always starts at position 0")
         if self.prefix_budget_bytes < 0:
             raise ValueError("prefix_budget_bytes must be >= 0")
+        if self.page_tokens < 1:
+            raise ValueError("page_tokens must be >= 1")
+        if self.pool_pages < 0:
+            raise ValueError("pool_pages must be >= 0")
+        if self.paged:
+            if self.prefill_chunk <= 0:
+                raise ValueError(
+                    "paged KV needs chunked prefill (prefill_chunk > 0): "
+                    "pages are allocated on demand as the chunk frontier "
+                    "advances — the monolithic bucketed prefill would "
+                    "need every page up front per bucket")
+            if self.prefill_chunk % self.page_tokens != 0:
+                raise ValueError(
+                    "paged KV needs prefill_chunk to be a multiple of "
+                    f"page_tokens (got chunk {self.prefill_chunk}, page "
+                    f"{self.page_tokens}): chunk boundaries must land on "
+                    "page boundaries so mid-prefill appends never touch "
+                    "an unallocated page and shared prefix spans are "
+                    "whole pages")
 
     # -- layout ------------------------------------------------------------
 
@@ -129,19 +151,57 @@ class KVCachePolicy:
         """
         import jax.numpy as jnp
 
-        shape = (n_rows, cfg.n_kv_groups, max_length, cfg.head_dim)
+        if self.paged:
+            n_pages = self.total_pool_pages(n_rows, max_length)
+            shape = (n_pages, cfg.n_kv_groups, self.page_tokens,
+                     cfg.head_dim)
+            sshape = (n_pages, cfg.n_kv_groups, self.page_tokens, 1)
+        else:
+            shape = (n_rows, cfg.n_kv_groups, max_length, cfg.head_dim)
+            sshape = (n_rows, cfg.n_kv_groups, max_length, 1)
         dt = self.cache_dtype(cfg)
         cache: Params = {
             "k": [jnp.zeros(shape, dt) for _ in range(cfg.n_layers)],
             "v": [jnp.zeros(shape, dt) for _ in range(cfg.n_layers)],
         }
         if self.quantized:
-            sshape = (n_rows, cfg.n_kv_groups, max_length, 1)
             cache["k_scale"] = [jnp.zeros(sshape, jnp.float32)
                                 for _ in range(cfg.n_layers)]
             cache["v_scale"] = [jnp.zeros(sshape, jnp.float32)
                                 for _ in range(cfg.n_layers)]
         return cache
+
+    # -- paged layout --------------------------------------------------------
+
+    def pages_per_slot(self, max_length: int) -> int:
+        """Page-table width: enough table columns to map a full-length
+        row. A slot never maps more — oversubscription shrinks the POOL,
+        never the table (the table shape is compiled into the programs)."""
+        return -(-max_length // self.page_tokens)
+
+    def total_pool_pages(self, n_rows: int, max_length: int) -> int:
+        """Physical pages allocated on device: the usable pool
+        (``pool_pages``, defaulting to ``n_rows`` full-length rows —
+        contiguous-equivalent capacity) plus the reserved trash page 0.
+
+        Page 0 is never owned by any slot: zeroed table entries point at
+        it, so out-of-range appends (a free row's garbage lane, a
+        mid-prefill row's clamped tail) land there instead of corrupting
+        live pages, and gathers from it are always masked."""
+        usable = self.pool_pages or n_rows * self.pages_per_slot(max_length)
+        return usable + 1
+
+    def page_bytes(self, cfg: ModelConfig) -> int:
+        """Device bytes of ONE page across every layer and sidecar — the
+        exact quantum the ledger reconciles against: total cache bytes
+        == total_pool_pages x page_bytes."""
+        import jax.numpy as jnp
+
+        width = jnp.dtype(self.cache_dtype(cfg)).itemsize
+        per = 2 * cfg.n_layers * cfg.n_kv_groups * self.page_tokens
+        kv = per * cfg.head_dim * width
+        scale = per * 4 if self.quantized else 0
+        return kv + scale
 
     def bytes_per_slot(self, cfg: ModelConfig, max_length: int) -> Dict[str, int]:
         """Per-slot cache bytes under this policy: the HBM number that
@@ -162,9 +222,14 @@ class KVCachePolicy:
 
     def describe(self) -> Dict[str, Any]:
         """Event-payload summary (rides ``serve_warmup``)."""
-        return {"kv_quant": self.kv_quant,
-                "prefix_cache": self.prefix_cache,
-                "prefill_chunk": self.prefill_chunk}
+        out = {"kv_quant": self.kv_quant,
+               "prefix_cache": self.prefix_cache,
+               "prefill_chunk": self.prefill_chunk}
+        if self.paged:
+            out["kv_paged"] = True
+            out["page_tokens"] = self.page_tokens
+            out["pool_pages"] = self.pool_pages
+        return out
 
 
 #: slot caches allocated before the policy object existed (or by older
@@ -189,6 +254,148 @@ def cache_nbytes(cache: Params) -> int:
         else:
             total += leaves.nbytes
     return total
+
+
+# ---------------------------------------------------------------------------
+# the page pool (paged layout only; host-side allocator)
+# ---------------------------------------------------------------------------
+
+class PagePool:
+    """Host-side allocator + refcounts for the shared device page pool.
+
+    The device arrays are a flat pool of ``n_pages`` fixed-size pages;
+    WHICH page holds WHICH slot's positions is pure host bookkeeping —
+    the per-slot int32 page table rides the jitted programs as traced
+    data (the adapter-pool trick: identity is data, capacity is static,
+    so page churn never recompiles anything).
+
+    Refcounts make prefix sharing copy-free: a prefix hit increfs the
+    stored entry's pages and writes their ids into the slot's table —
+    zero device work. A page returns to the free list only when its LAST
+    owner (slot or store entry) drops it, so effective capacity is
+    bounded by tokens in flight, not ``n_slots x Tmax``.
+
+    ``reserved`` is the admission ledger: admitting a request reserves
+    its worst-case PRIVATE page need up front (free pages minus reserved
+    is what admission may promise next), and each on-demand allocation
+    by that slot draws its reservation down — so two admitted requests
+    can never deadlock mid-decode fighting over the same last page.
+
+    Page 0 is the trash page: permanently allocated, never freed, the
+    target of every zeroed table entry (see
+    ``KVCachePolicy.total_pool_pages``).
+
+    Thread-safe (leaf lock; callers hold the engine lock anyway, but
+    stats/ledger probes may fire from admin threads).
+    """
+
+    def __init__(self, n_pages: int, page_bytes: int):
+        if n_pages < 2:
+            raise ValueError("PagePool needs >= 2 pages (trash + 1 usable)")
+        self.n_pages = int(n_pages)
+        self.page_bytes = int(page_bytes)
+        self._lock = threading.Lock()
+        self._refs = np.zeros(self.n_pages, np.int64)   # guarded-by: _lock
+        self._refs[0] = 1                               # trash page: pinned
+        # lowest-id-first free list keeps page ids dense and runs
+        # byte-reproducible across identical request sequences
+        self._free = list(range(self.n_pages - 1, 0, -1))  # pop() -> lowest
+        self.reserved = 0               # guarded-by: _lock
+        self.n_allocs = 0               # guarded-by: _lock
+        self.n_frees = 0                # guarded-by: _lock
+        self.peak_used = 0              # guarded-by: _lock
+
+    # -- allocation ----------------------------------------------------------
+
+    def alloc(self, *, from_reserved: bool = False) -> int:
+        """Take the lowest free page (refcount 1). ``from_reserved=True``
+        consumes one unit of the admission reservation that promised
+        this page. Raises ``RuntimeError`` on exhaustion — admission
+        checks ``available()`` first, so running dry here is an
+        accounting bug, not an oversubscription event."""
+        with self._lock:
+            if not self._free:
+                raise RuntimeError(
+                    "page pool exhausted: admission reservation "
+                    "accounting is broken (alloc past available())")
+            page = self._free.pop()
+            self._refs[page] = 1
+            if from_reserved:
+                self.reserved = max(self.reserved - 1, 0)
+            self.n_allocs += 1
+            used = self.n_pages - 1 - len(self._free)
+            if used > self.peak_used:
+                self.peak_used = used
+            return page
+
+    def incref(self, page: int) -> None:
+        with self._lock:
+            if page == 0 or self._refs[page] <= 0:
+                raise RuntimeError(
+                    f"incref on unallocated page {page} (use-after-free)")
+            self._refs[page] += 1
+
+    def decref(self, page: int) -> bool:
+        """Drop one reference; returns True when the page went back to
+        the free list."""
+        with self._lock:
+            if page == 0:
+                return False            # trash page is never freed
+            if self._refs[page] <= 0:
+                raise RuntimeError(
+                    f"decref on free page {page} (double free)")
+            self._refs[page] -= 1
+            if self._refs[page] == 0:
+                self._free.append(page)
+                self._free.sort(reverse=True)
+                self.n_frees += 1
+                return True
+            return False
+
+    def refcount(self, page: int) -> int:
+        with self._lock:
+            return int(self._refs[page])
+
+    # -- admission ledger ----------------------------------------------------
+
+    def available(self) -> int:
+        """Free pages not yet promised to an admitted request — what
+        admission may still hand out."""
+        with self._lock:
+            return len(self._free) - self.reserved
+
+    def reserve(self, n: int) -> None:
+        with self._lock:
+            self.reserved += int(n)    # graft-ok: GL011 host int
+
+    def unreserve(self, n: int) -> None:
+        with self._lock:
+            self.reserved = max(
+                self.reserved - int(n), 0)  # graft-ok: GL011 host int
+
+    # -- introspection -------------------------------------------------------
+
+    @property
+    def n_free(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    def used_pages(self) -> int:
+        """Allocated pages, trash page excluded."""
+        with self._lock:
+            return self.n_pages - 1 - len(self._free)
+
+    def stats(self) -> dict:
+        with self._lock:
+            used = self.n_pages - 1 - len(self._free)
+            return {"n_pages": self.n_pages - 1,     # usable (sans trash)
+                    "page_bytes": self.page_bytes,
+                    "used": used,
+                    "free": len(self._free),
+                    "reserved": self.reserved,
+                    "peak_used": self.peak_used,
+                    "allocs": self.n_allocs,
+                    "frees": self.n_frees}
 
 
 # ---------------------------------------------------------------------------
@@ -252,10 +459,11 @@ def extract_prefix_panes(cache: Params, slot, n_valid, *,
 
 class _Entry:
     __slots__ = ("key", "panes", "span", "nbytes", "pins", "hits",
-                 "t_insert", "tag")
+                 "t_insert", "tag", "pages")
 
-    def __init__(self, key: str, panes: Params, span: int, nbytes: int,
-                 tag: Optional[str] = None):
+    def __init__(self, key: str, panes: Optional[Params], span: int,
+                 nbytes: int, tag: Optional[str] = None,
+                 pages: Optional[List[int]] = None):
         self.key = key
         self.panes = panes
         self.span = span
@@ -267,6 +475,10 @@ class _Entry:
         # attribution; None for raw-key imports (the donor's tag is
         # hashed into the key but not transported)
         self.tag = tag
+        # paged layout: the store owns REFERENCES to shared pool pages
+        # instead of a private pane copy (panes is None) — nbytes is the
+        # pages' pool footprint, charged against the same LRU budget
+        self.pages = pages
 
 
 class PrefixStore:
@@ -288,13 +500,17 @@ class PrefixStore:
     """
 
     def __init__(self, fingerprint: str, *, chunk_tokens: int,
-                 budget_bytes: int, pane_tokens: int):
+                 budget_bytes: int, pane_tokens: int,
+                 page_pool: Optional[PagePool] = None):
         if chunk_tokens < 1:
             raise ValueError("chunk_tokens must be >= 1")
         self.fingerprint = fingerprint
         self.chunk_tokens = int(chunk_tokens)
         self.budget_bytes = int(budget_bytes)
         self.pane_tokens = int(pane_tokens)
+        # paged layout: entries hold pool page ids, and eviction must
+        # return the store's references to this pool
+        self.page_pool = page_pool
         self._lock = threading.Lock()
         self._entries: "OrderedDict[str, _Entry]" = OrderedDict()
         self.bytes_total = 0            # guarded-by: _lock
@@ -376,6 +592,20 @@ class PrefixStore:
         return self._insert_keyed(self.key(token_ids, tag), panes,
                                   len(token_ids), tag=tag)
 
+    def insert_pages(self, token_ids, tag: str, pages: List[int]) -> int:
+        """Paged insert: store REFERENCES to the donor slot's pool pages
+        instead of copying panes — the store increfs each page (its own
+        ownership, outliving the donor slot) and charges their pool
+        footprint to the same LRU byte budget. Zero device work: the
+        panes already live in the pool; sharing is bookkeeping."""
+        if self.page_pool is None:
+            raise RuntimeError("insert_pages needs a page_pool-backed "
+                               "PrefixStore")
+        return self._insert_keyed(
+            self.key(token_ids, tag), None, len(token_ids), tag=tag,
+            pages=list(pages),
+            nbytes=len(pages) * self.page_pool.page_bytes)
+
     def import_entry(self, key: str, panes: Params, span: int) -> int:
         """Raw-key insert for cross-process pane handoff (fleet drain).
 
@@ -393,11 +623,17 @@ class PrefixStore:
         layer serializes them."""
         with self._lock:
             return [(e.key, e.span, e.panes)
-                    for e in self._entries.values()]
+                    for e in self._entries.values()
+                    if e.panes is not None]    # paged entries hold pool
+                                               # page ids, meaningless in
+                                               # another process's pool
 
-    def _insert_keyed(self, k: str, panes: Params, span: int,
-                      tag: Optional[str] = None) -> int:
-        nbytes = cache_nbytes(panes)
+    def _insert_keyed(self, k: str, panes: Optional[Params], span: int,
+                      tag: Optional[str] = None,
+                      pages: Optional[List[int]] = None,
+                      nbytes: Optional[int] = None) -> int:
+        if nbytes is None:
+            nbytes = cache_nbytes(panes)
         evicted = []
         with self._lock:
             if k in self._entries:
@@ -417,13 +653,19 @@ class PrefixStore:
                 self.bytes_total -= victim.nbytes
                 self.n_evictions += 1
                 evicted.append(victim)
-            entry = _Entry(k, panes, span, nbytes, tag=tag)
+            if pages is not None:
+                # the store's own references; the donor slot keeps its
+                # refs and drops them independently at retirement
+                for p in pages:
+                    self.page_pool.incref(p)
+            entry = _Entry(k, panes, span, nbytes, tag=tag, pages=pages)
             self._entries[k] = entry
             self.bytes_total += nbytes
             self.n_inserts += 1
             n_entries = len(self._entries)
             bytes_total = self.bytes_total
         for victim in evicted:
+            self._release_victim_pages(victim)
             get_metrics().event(
                 "prefix_evict", key=victim.key, bytes=victim.nbytes,
                 span_tokens=victim.span, hits=victim.hits,
@@ -433,6 +675,28 @@ class PrefixStore:
                      "%d evicted).", k[:12], span, nbytes,
                      n_entries, len(evicted))
         return nbytes
+
+    def _release_victim_pages(self, victim: _Entry) -> None:
+        """Return an evicted/cleared paged entry's page references to
+        the pool (pages whose last owner was the store go back on the
+        free list — eviction RECLAIMS capacity, exactly like freeing a
+        pane copy did in the contiguous layout)."""
+        if victim.pages is not None and self.page_pool is not None:
+            for p in victim.pages:
+                self.page_pool.decref(p)
+
+    def clear(self) -> None:
+        """Drop every entry, releasing paged page references. The paged
+        engine restart path calls this: stored entries reference pages
+        of the ABOUT-TO-BE-REPLACED pool arrays, so unlike the
+        contiguous store (whose private pane copies survive a cache
+        rebuild) they cannot outlive a restart."""
+        with self._lock:
+            victims = list(self._entries.values())
+            self._entries.clear()
+            self.bytes_total = 0
+        for victim in victims:
+            self._release_victim_pages(victim)
 
     # -- introspection -----------------------------------------------------
 

@@ -78,6 +78,19 @@ class RequestQueue:
                         f"after {timeout}s")
             self._q.append(req)
 
+    def put_front(self, req: Request) -> None:
+        """Re-queue a request at the HEAD, bypassing the capacity check.
+
+        The paged engine's oversubscription path: admission popped the
+        request (the scheduler's ``admit_from`` is destructive) and THEN
+        found the page pool too drained for its worst-case need — the
+        request must go back where it was, ahead of everything behind
+        it, even if callers filled the queue meanwhile. Capacity was
+        already charged when it was first admitted; bouncing it now
+        would turn a transient full pool into a spurious reject."""
+        with self._not_full:
+            self._q.appendleft(req)
+
     def get_nowait(self) -> Optional[Request]:
         """Pop the oldest request, or None when empty."""
         with self._not_full:

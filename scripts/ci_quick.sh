@@ -345,6 +345,75 @@ print(f"kv memory engine smoke ok: 8/8 requests, "
       f"({warm['kv_bytes_per_slot']}B/slot), 0 recompiles")
 EOF
 
+echo "== paged KV smoke (page-table engine + shared pages, CPU) =="
+JAX_PLATFORMS=cpu python - <<'EOF' || exit 1
+import json, os, tempfile
+d = tempfile.mkdtemp()
+# The same shared-prefix workload through the REAL CLI with the paged
+# KV engine on: the 8-byte system prompt is ONE 8-token page, so
+# requests 2..8 must hit the store and land SHARED page-table entries
+# (zero pane-copy bytes), the allocator must recycle retired slots'
+# pages, and the ledger must reconcile the pool byte-exact.
+reqs = os.path.join(d, "requests.jsonl")
+system = "abcdefgh"
+with open(reqs, "w") as f:
+    for i in range(8):
+        f.write(json.dumps({"prompt": system + "ij"[i % 2],
+                            "max_new_tokens": 4,
+                            "ignore_eos": True, "seed": i}) + "\n")
+out = os.path.join(d, "results.jsonl")
+mj = os.path.join(d, "metrics.jsonl")
+from building_llm_from_scratch_tpu.args import get_args
+from building_llm_from_scratch_tpu.main import main
+engine = main(get_args([
+    "--mode", "serve", "--debug", "--byte_tokenizer", "--data_dir", d,
+    "--serve_prompts", reqs, "--serve_out", out,
+    "--serve_slots", "4", "--serve_max_queue", "8",
+    "--serve_kv_paged", "on", "--serve_kv_page_tokens", "8",
+    "--serve_prefix_cache", "on", "--serve_prefill_chunk", "8",
+    "--metrics_jsonl", mj,
+]))
+results = [json.loads(l) for l in open(out)]
+assert len(results) == 8, f"expected 8 results, got {len(results)}"
+assert all(r["finish_reason"] == "length" for r in results), results
+rows = [json.loads(l) for l in open(mj)]
+shares = [r for r in rows if r.get("event") == "page_share"]
+assert len(shares) >= 7, f"expected >=7 shared-page hits: {len(shares)}"
+assert all(r["n_pages"] >= 1 for r in shares), shares
+stats = engine.stats()
+assert stats["pane_copies"] == 0, "paged hit copied panes"
+pool = stats["page_pool"]
+assert pool["frees"] > 0, f"no page recycling: {pool}"
+assert pool["reserved"] == 0 and pool["used"] == 1, pool  # store's page
+# ledger: page_pool component == the allocator's own arithmetic, exact
+engine.memory_ledger.observe(engine.n_ticks)
+mem = engine.memory_ledger.describe()
+expect = engine.page_pool.n_pages * engine.page_pool.page_bytes
+assert mem["components"]["page_pool"] == expect, (mem, expect)
+assert mem["n_drift_events"] == 0, mem
+assert not [r for r in rows if r.get("event") == "recompile"]
+assert engine.n_recompiles == 0
+warm = [r for r in rows if r.get("event") == "serve_warmup"][0]
+assert warm["kv_paged"] is True and warm["page_tokens"] == 8, warm
+print(f"paged KV smoke ok: 8/8 requests, {len(shares)} shared-page "
+      f"hits, 0 pane copies, pool peak {pool['peak_used']}/"
+      f"{pool['n_pages']} pages, ledger exact, 0 recompiles")
+EOF
+
+echo "== paged flag guard (stray --serve_kv_paged outside serve mode) =="
+JAX_PLATFORMS=cpu python - <<'EOF' || exit 1
+import tempfile
+from building_llm_from_scratch_tpu.args import get_args
+try:
+    get_args(["--debug", "--data_dir", tempfile.mkdtemp(),
+              "--serve_kv_paged", "on"])
+except ValueError as e:
+    assert "--serve_kv_paged" in str(e) and "--mode serve" in str(e), e
+    print("stray --serve_kv_paged rejected outside serve mode")
+else:
+    raise SystemExit("stray --serve_kv_paged on was silently accepted")
+EOF
+
 echo "== speculative decoding smoke (train repetitive -> spec serve, CPU) =="
 JAX_PLATFORMS=cpu python - <<'EOF' || exit 1
 import json, os, tempfile

@@ -85,7 +85,8 @@ BASELINE_JSONL_DIR = os.path.join(REPO_ROOT, "results", "perf", "baseline")
 #: the fused-finetune step, and the router path's PER-REPLICA program
 #: family (watch_compiles="first": replica-count invariant).
 GATE_BENCHES = ("micro_train", "micro_accum", "micro_serve",
-                "micro_lora_fusion", "micro_spec", "micro_router")
+                "micro_paged", "micro_lora_fusion", "micro_spec",
+                "micro_router")
 
 #: Env fields whose drift invalidates structural comparability (a
 #: different XLA counts different FLOPs) — reported, not silently eaten.

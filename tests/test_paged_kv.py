@@ -1,0 +1,465 @@
+"""Paged KV-cache tests (serving/kvcache.PagePool + the page-table
+engine path): page-pool allocator units (alloc/free/refcount, admission
+reservations, exhaustion), policy validation, engine-vs-generate() token
+BIT-parity with paging ON across greedy/sampled/spec/adapter/int8
+traffic, copy-free prefix sharing (pane_copies spy == 0, shared pages
+refcounted and released on retire/restart), oversubscription admission
+(free PAGES gate, FCFS-preserving bounce, permanent refusal of
+can-never-fit requests), byte-exact ledger reconcile over the pool,
+zero recompiles throughout, paged telemetry events against the schema,
+and interpret-mode parity for the pallas page-gather attention kernel.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from building_llm_from_scratch_tpu.configs import ModelConfig
+from building_llm_from_scratch_tpu.generate import generate
+from building_llm_from_scratch_tpu.models import init_params
+from building_llm_from_scratch_tpu.serving import (
+    DecodeEngine,
+    KVCachePolicy,
+    SamplingParams,
+)
+from building_llm_from_scratch_tpu.serving.kvcache import (
+    DEFAULT_POLICY,
+    PagePool,
+    cache_nbytes,
+)
+
+PAGED = KVCachePolicy(paged=True, page_tokens=8, prefill_chunk=16,
+                      prefix_cache=True)
+
+
+def tiny_cfg(ctx=64, **kw):
+    base = dict(name="paged-tiny", vocab_size=96, context_length=ctx,
+                emb_dim=32, n_heads=2, n_layers=2, hidden_dim=64,
+                n_kv_groups=2, norm="layernorm", positional="learned",
+                activation="gelu", drop_rate=0.0, eos_id=1)
+    base.update(kw)
+    return ModelConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_cfg()
+    return cfg, init_params(cfg, jax.random.PRNGKey(0))
+
+
+def solo_tokens(params, cfg, prompt, sp: SamplingParams):
+    out, n = generate(params, cfg, np.asarray(prompt)[None],
+                      max_new_tokens=sp.max_new_tokens,
+                      temperature=sp.temperature, top_k=sp.top_k,
+                      eos_id=(None if sp.ignore_eos
+                              else (sp.eos_id if sp.eos_id is not None
+                                    else cfg.eos_id)),
+                      rng=jax.random.PRNGKey(sp.seed),
+                      return_n_generated=True)
+    Tp = len(prompt)
+    return [int(t) for t in out[0, Tp: Tp + int(n[0])]]
+
+
+def shared_prefix_prompts(cfg, n, prefix_len=32, seed=0):
+    rng = np.random.default_rng(seed)
+    prefix = rng.integers(2, cfg.vocab_size, (prefix_len,)).astype(np.int32)
+    return [np.concatenate([prefix, rng.integers(
+        2, cfg.vocab_size, (2 + i % 3,)).astype(np.int32)])
+        for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# PagePool allocator units
+# ---------------------------------------------------------------------------
+
+def test_page_pool_alloc_free_refcount():
+    pool = PagePool(6, 128)                    # trash + 5 usable
+    assert pool.n_free == 5 and pool.available() == 5
+    assert pool.refcount(0) == 1               # trash page: pinned
+
+    a = pool.alloc()
+    b = pool.alloc()
+    assert (a, b) == (1, 2)                    # lowest-id-first: dense ids
+    assert pool.refcount(a) == 1
+
+    pool.incref(a)                             # a prefix-store sharer
+    assert pool.refcount(a) == 2
+    assert pool.decref(a) is False             # still one owner left
+    assert pool.decref(a) is True              # last owner: back to free
+    assert pool.n_free == 4
+    with pytest.raises(RuntimeError, match="double free"):
+        pool.decref(a)
+    with pytest.raises(RuntimeError, match="use-after-free"):
+        pool.incref(a)
+
+    # trash page is never refcounted into the free list
+    assert pool.decref(0) is False
+    with pytest.raises(RuntimeError):
+        pool.incref(0)
+
+    # freed ids are reused lowest-first (byte-reproducible sequences)
+    assert pool.alloc() == 1
+    pool.decref(b)
+
+    st = pool.stats()
+    assert st["n_pages"] == 5 and st["page_bytes"] == 128
+    assert st["allocs"] == 3 and st["frees"] == 2
+    assert st["used"] == 1 and st["free"] == 4
+    assert st["peak_used"] == 2
+
+
+def test_page_pool_reservations_and_exhaustion():
+    pool = PagePool(4, 64)                     # 3 usable
+    pool.reserve(2)
+    assert pool.available() == 1               # free minus promised
+    p = pool.alloc(from_reserved=True)         # draws the reservation down
+    assert pool.stats()["reserved"] == 1
+    assert pool.available() == 1
+    pool.unreserve(1)
+    assert pool.available() == 2
+
+    q = pool.alloc()
+    r = pool.alloc()
+    assert pool.n_free == 0
+    with pytest.raises(RuntimeError, match="exhausted"):
+        pool.alloc()                           # admission gate was bypassed
+    for page in (p, q, r):
+        pool.decref(page)
+    assert pool.n_free == 3
+
+
+def test_paged_policy_validation():
+    with pytest.raises(ValueError, match="chunked prefill"):
+        KVCachePolicy(paged=True)              # pages need a chunk frontier
+    with pytest.raises(ValueError, match="multiple"):
+        KVCachePolicy(paged=True, prefill_chunk=12, page_tokens=8)
+    with pytest.raises(ValueError):
+        KVCachePolicy(paged=True, prefill_chunk=16, page_tokens=0)
+    # contiguous layout stays the pinned default
+    assert DEFAULT_POLICY.paged is False
+    assert KVCachePolicy().paged is False
+
+
+# ---------------------------------------------------------------------------
+# engine parity + copy-free sharing
+# ---------------------------------------------------------------------------
+
+def test_paged_engine_parity_and_copy_free_sharing(model):
+    """Greedy + sampled traffic over a shared prefix: tokens bit-equal
+    to one-shot generate(), hits are TABLE WRITES (the contiguous pane
+    copy spy stays 0), the ledger reconciles byte-exact over the pool,
+    and nothing recompiles."""
+    cfg, params = model
+    eng = DecodeEngine(cfg, params, n_slots=2, max_len=48,
+                       warmup_prompt_cap=48, kv_policy=PAGED)
+    eng.warmup()
+    base = eng.n_recompiles
+    prompts = shared_prefix_prompts(cfg, 4)
+    plans = [SamplingParams(max_new_tokens=4, ignore_eos=True, seed=7),
+             SamplingParams(max_new_tokens=4, temperature=0.8, top_k=20,
+                            ignore_eos=True, seed=11),
+             SamplingParams(max_new_tokens=3, ignore_eos=True, seed=13),
+             SamplingParams(max_new_tokens=4, temperature=1.1, top_k=8,
+                            ignore_eos=True, seed=17)]
+    handles = [eng.submit(p, sp) for p, sp in zip(prompts, plans)]
+    eng.run_until_idle()
+    for h, p, sp in zip(handles, prompts, plans):
+        assert h.done and h.output_ids == solo_tokens(params, cfg, p, sp)
+
+    st = eng.stats()
+    assert eng.n_recompiles == base == 0
+    assert st["pane_copies"] == 0              # zero-copy hits: table only
+    assert st["prefix_store"]["hits"] >= 1
+    pool = st["page_pool"]
+    assert pool["frees"] > 0                   # retired slots recycle pages
+    # after idle the only retained pages are the store's shared prefix
+    # (32 tokens / 8 per page = 4) — capacity is tokens in flight
+    assert pool["used"] == 4 and pool["reserved"] == 0
+
+    # ledger: the pool component reconciles byte-exact (expected from
+    # the allocator's own arithmetic == measured device bytes)
+    eng.memory_ledger.observe(eng.n_ticks)
+    desc = eng.memory_ledger.describe()
+    assert desc["components"]["page_pool"] == cache_nbytes(eng.cache)
+    assert desc["components"]["page_pool"] == (
+        eng.page_pool.n_pages * eng.page_pool.page_bytes)
+    assert desc["n_drift_events"] == 0         # expected == measured, exact
+
+
+def test_paged_spec_decode_parity(model):
+    """Speculative decoding over paged KV: verify-tick page growth covers
+    the k-token window and accepted tokens stay bit-identical."""
+    cfg, params = model
+    eng = DecodeEngine(cfg, params, n_slots=2, max_len=48,
+                       warmup_prompt_cap=48, kv_policy=PAGED, spec_k=3)
+    eng.warmup()
+    prompts = shared_prefix_prompts(cfg, 3, prefix_len=16, seed=3)
+    sp = SamplingParams(max_new_tokens=6, ignore_eos=True, seed=23)
+    handles = [eng.submit(p, sp) for p in prompts]
+    eng.run_until_idle()
+    for h, p in zip(handles, prompts):
+        assert h.done and h.output_ids == solo_tokens(params, cfg, p, sp)
+    assert eng.n_recompiles == 0
+    assert eng.stats()["pane_copies"] == 0
+
+
+def test_paged_int8_sidecar(model):
+    """int8 KV pages carry their fp32 scale sidecar page-for-page: the
+    quantized paged engine matches the quantized CONTIGUOUS engine
+    bit-for-bit (same quantization points, different layout)."""
+    cfg, params = model
+    pol8 = KVCachePolicy(paged=True, page_tokens=8, prefill_chunk=16,
+                         prefix_cache=True, kv_quant="int8")
+    eng = DecodeEngine(cfg, params, n_slots=2, max_len=48,
+                       warmup_prompt_cap=48, kv_policy=pol8)
+    eng.warmup()
+    ref = DecodeEngine(cfg, params, n_slots=2, max_len=48,
+                       warmup_prompt_cap=48,
+                       kv_policy=KVCachePolicy(kv_quant="int8"))
+    ref.warmup()
+    prompts = shared_prefix_prompts(cfg, 3, prefix_len=16, seed=5)
+    sp = SamplingParams(max_new_tokens=4, ignore_eos=True, seed=31)
+    hs = [eng.submit(p, sp) for p in prompts]
+    eng.run_until_idle()
+    rs = [ref.submit(p, sp) for p in prompts]
+    ref.run_until_idle()
+    for h, r in zip(hs, rs):
+        assert h.done and h.output_ids == r.output_ids
+    assert eng.n_recompiles == 0
+    # page_bytes includes the sidecar: K+V int8 + two fp32 scale columns
+    per_page = eng.kv_policy.page_bytes(cfg)
+    assert per_page == eng.page_pool.page_bytes
+    k_bytes = cfg.n_kv_groups * 8 * cfg.head_dim      # int8 = 1 B/elt
+    s_bytes = cfg.n_kv_groups * 8 * 1 * 4             # fp32 scales
+    assert per_page == cfg.n_layers * 2 * (k_bytes + s_bytes)
+
+
+def test_paged_adapter_parity(model, tmp_path):
+    """Mixed base/LoRA traffic over paged KV: every request bit-matches
+    generate() on its own merged weights, co-resident, zero recompiles."""
+    from building_llm_from_scratch_tpu.models.lora import (
+        init_lora_params,
+        merge_lora,
+        save_adapter,
+    )
+    from building_llm_from_scratch_tpu.serving import AdapterRegistry
+
+    cfg, params = model
+    specs, merged = {}, {}
+    for i, (name, rank, alpha) in enumerate([("a", 4, 8.0), ("b", 2, 3.0)]):
+        lora = init_lora_params(cfg, params, jax.random.PRNGKey(40 + i),
+                                rank=rank)
+        lora = jax.tree_util.tree_map(
+            lambda x: x + 0.05 * jax.random.normal(
+                jax.random.PRNGKey(50 + i), x.shape, x.dtype), lora)
+        path = str(tmp_path / f"{name}.npz")
+        save_adapter(path, lora, rank=rank, alpha=alpha, cfg=cfg)
+        specs[name] = path
+        merged[name] = merge_lora(params, lora, alpha=alpha, rank=rank)
+    registry = AdapterRegistry.from_artifacts(cfg, params, specs,
+                                              capacity=4)
+    eng = DecodeEngine(cfg, params, n_slots=2, max_len=48,
+                       warmup_prompt_cap=48, kv_policy=PAGED,
+                       adapters=registry)
+    eng.warmup()
+    prompts = shared_prefix_prompts(cfg, 4, prefix_len=16, seed=9)
+    sp = SamplingParams(max_new_tokens=4, ignore_eos=True, seed=43)
+    names = [None, "a", "b", "a"]
+    handles = [eng.submit(p, SamplingParams(max_new_tokens=4,
+                                            ignore_eos=True, seed=43,
+                                            adapter=name))
+               for p, name in zip(prompts, names)]
+    eng.run_until_idle()
+    for h, p, name in zip(handles, prompts, names):
+        ref = params if name is None else merged[name]
+        assert h.done and h.output_ids == solo_tokens(ref, cfg, p, sp)
+    assert eng.n_recompiles == 0
+
+
+# ---------------------------------------------------------------------------
+# oversubscription: admission gates on FREE PAGES
+# ---------------------------------------------------------------------------
+
+def test_pool_oversubscription_admits_by_pages_fcfs(model):
+    """A pool sized for ~one request at a time: free SLOTS exceed free
+    pages, so admission bounces the queue head (and everything behind
+    it, order intact) until a retirement frees pages — every request
+    still completes with exact tokens."""
+    cfg, params = model
+    # worst case per request: ceil((16 prompt + 4 new)/8) = 3 pages;
+    # 4 usable pages admit one request (+1 slack), never two
+    pol = KVCachePolicy(paged=True, page_tokens=8, prefill_chunk=16,
+                        pool_pages=4)
+    eng = DecodeEngine(cfg, params, n_slots=2, max_len=32,
+                       warmup_prompt_cap=32, kv_policy=pol)
+    eng.warmup()
+    rng = np.random.default_rng(12)
+    prompts = [rng.integers(2, cfg.vocab_size, (16,)).astype(np.int32)
+               for _ in range(3)]
+    sp = SamplingParams(max_new_tokens=4, ignore_eos=True, seed=51)
+    first_token_order = []
+
+    def on_tok(req, _tok, _txt):
+        if len(req.output_ids) == 1:
+            first_token_order.append(req.id)
+
+    handles = [eng.submit(p, sp, on_token=on_tok) for p in prompts]
+    eng.run_until_idle()
+    # FCFS preserved through bounces: each request starts decoding in
+    # submission order (the bounced head goes back to the FRONT)
+    assert first_token_order == [h.id for h in handles]
+    for h, p in zip(handles, prompts):
+        assert h.done and h.finish_reason == "length"
+        assert h.output_ids == solo_tokens(params, cfg, p, sp)
+    st = eng.stats()["page_pool"]
+    assert st["peak_used"] <= 4                # never oversubscribed the pool
+    assert st["used"] == 0 and st["reserved"] == 0
+    assert eng.n_recompiles == 0
+
+
+def test_pool_request_that_can_never_fit_fails_fast(model):
+    """A request whose worst-case page need exceeds the WHOLE pool must
+    fail at admission (bouncing it would livelock the queue head)."""
+    cfg, params = model
+    pol = KVCachePolicy(paged=True, page_tokens=8, prefill_chunk=16,
+                        pool_pages=2)          # 16 tokens of pool, total
+    eng = DecodeEngine(cfg, params, n_slots=2, max_len=32,
+                       warmup_prompt_cap=16, kv_policy=pol)
+    eng.warmup()
+    big = np.arange(2, 18, dtype=np.int32)     # 16 prompt + 4 new > 2 pages
+    h = eng.submit(big, SamplingParams(max_new_tokens=4, ignore_eos=True))
+    small = np.arange(2, 10, dtype=np.int32)   # 8 + 2 -> 2 pages: fits
+    h2 = eng.submit(small, SamplingParams(max_new_tokens=2,
+                                          ignore_eos=True, seed=3))
+    eng.run_until_idle()
+    assert h.done and h.finish_reason == "error"
+    assert "pages" in h.error
+    # the queue behind the refused request keeps flowing
+    assert h2.done and h2.finish_reason == "length"
+    assert eng.stats()["page_pool"]["used"] == 0
+
+
+# ---------------------------------------------------------------------------
+# shared-page release: retire / cancel / restart
+# ---------------------------------------------------------------------------
+
+def test_shared_pages_release_on_cancel_and_restart(model):
+    cfg, params = model
+    eng = DecodeEngine(cfg, params, n_slots=2, max_len=48,
+                       warmup_prompt_cap=48, kv_policy=PAGED)
+    eng.warmup()
+    prompts = shared_prefix_prompts(cfg, 2)
+    sp = SamplingParams(max_new_tokens=4, ignore_eos=True, seed=61)
+    hs = [eng.submit(p, sp) for p in prompts]
+    eng.run_until_idle()
+    assert all(h.done for h in hs)
+    store_pages = eng.stats()["page_pool"]["used"]
+    assert store_pages == 4                    # 32-token prefix / 8
+
+    # cancel-while-queued: the request never touches the pool (no
+    # background ticker — a submitted request stays QUEUED until
+    # run_until_idle steps the engine)
+    h_c = eng.submit(prompts[0], sp)
+    assert eng.cancel(h_c) is True
+    eng.run_until_idle()
+    assert h_c.finish_reason == "cancelled"
+    assert eng.stats()["page_pool"]["used"] == store_pages
+
+    # restart: fresh pool + cleared store (stale tables must not leak
+    # into the rebuilt cache), then traffic still bit-matches
+    assert eng._restart(reason="test", detail="paged restart drill")
+    st = eng.stats()["page_pool"]
+    assert st["used"] == 0 and st["allocs"] == 0 and st["reserved"] == 0
+    h = eng.submit(prompts[0], sp)
+    eng.run_until_idle()
+    assert h.output_ids == solo_tokens(params, cfg, prompts[0], sp)
+    eng.memory_ledger.observe(eng.n_ticks)
+    assert eng.memory_ledger.describe()["n_drift_events"] == 0
+
+
+# ---------------------------------------------------------------------------
+# telemetry: page events land in the JSONL and validate
+# ---------------------------------------------------------------------------
+
+def test_paged_telemetry_events_schema(model, tmp_path):
+    from building_llm_from_scratch_tpu.obs.metrics import configure_metrics
+    from building_llm_from_scratch_tpu.obs.schema import validate_event
+
+    cfg, params = model
+    mj = str(tmp_path / "paged_metrics.jsonl")
+    sink = configure_metrics(mj)
+    sink.write_header(test="paged_kv")
+    try:
+        eng = DecodeEngine(cfg, params, n_slots=2, max_len=48,
+                           warmup_prompt_cap=48, kv_policy=PAGED)
+        eng.warmup()
+        sp = SamplingParams(max_new_tokens=2, ignore_eos=True)
+        for p in shared_prefix_prompts(cfg, 3):
+            eng.submit(p, sp)
+            eng.run_until_idle()
+        prom = eng.prometheus_text()
+    finally:
+        sink.close()
+        configure_metrics(None)
+    rows = [json.loads(line) for line in open(mj)]
+    by_kind = {}
+    for r in rows:
+        if r.get("type") == "event":
+            by_kind.setdefault(r["event"], []).append(r)
+    assert by_kind.get("page_admit") and by_kind.get("page_release")
+    assert by_kind.get("page_share")           # requests 2..3 shared pages
+    for kind in ("page_admit", "page_share", "page_release"):
+        for e in by_kind[kind]:
+            fields = {k: v for k, v in e.items()
+                      if k not in ("type", "time", "event", "step")}
+            assert validate_event(kind, fields) == [], (kind, e)
+    warm = by_kind["serve_warmup"][-1]
+    assert warm["kv_paged"] is True and warm["page_tokens"] == 8
+    assert warm["pool_pages"] == eng.page_pool.n_pages - 1
+    assert "bllm_serve_kv_pages_total" in prom
+    assert "bllm_serve_kv_pages_used" in prom
+
+
+# ---------------------------------------------------------------------------
+# pallas paged-attention kernel: interpret-mode parity on CPU
+# ---------------------------------------------------------------------------
+
+def test_paged_attention_interpret_parity():
+    from building_llm_from_scratch_tpu.ops.attention import decode_attention
+    from building_llm_from_scratch_tpu.ops.decode_step import (
+        paged_decode_attention,
+        supports_paged_shape,
+    )
+
+    S, Hq, Hkv, hd, P, N, M = 3, 4, 2, 64, 8, 9, 4
+    assert supports_paged_shape(1, P, hd)
+    assert not supports_paged_shape(2, P, hd)      # prefill: XLA path
+    assert not supports_paged_shape(1, P - 2, hd)  # unaligned page
+    assert not supports_paged_shape(1, P, 80)      # unaligned head dim
+
+    ks = jax.random.split(jax.random.PRNGKey(2), 3)
+    q = jax.random.normal(ks[0], (S, 1, Hq, hd))
+    k_pool = jax.random.normal(ks[1], (N, Hkv, P, hd))
+    v_pool = jax.random.normal(ks[2], (N, Hkv, P, hd))
+    # rows at different lengths, sharing physical page 1 (prefix hit),
+    # with tail table entries parked on the trash page 0
+    table = jnp.asarray([[1, 2, 0, 0],
+                         [1, 3, 4, 0],
+                         [5, 0, 0, 0]], jnp.int32)
+    lens = jnp.asarray([12, 20, 5], jnp.int32)     # new token's position
+
+    out = paged_decode_attention(q, k_pool, v_pool, table, lens,
+                                 interpret=True)
+    assert out.shape == (S, 1, Hq, hd)
+
+    # reference: materialize each row contiguously, then the stock
+    # decode_attention rule (attends kv_pos <= q_position)
+    K = k_pool[table].transpose(0, 2, 1, 3, 4).reshape(S, Hkv, M * P, hd)
+    V = v_pool[table].transpose(0, 2, 1, 3, 4).reshape(S, Hkv, M * P, hd)
+    ref = decode_attention(q, K, V, q_positions=lens[:, None],
+                           kv_length=lens + 1)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
