@@ -1669,6 +1669,132 @@ def bench_micro_spec():
                    detail=detail)
 
 
+def bench_micro_longctx(sp=2, warmup=1, iters=4):
+    """Debug-size sequence-sharded train step (longctx-32k architecture
+    shrunk, sp=2 over the seq mesh axis): the gate workload for the
+    long-context tier — its fingerprint pins the ring-attention step's
+    HLO (the ppermute ring schedule, the online-softmax rescale chain,
+    the seq-sharded batch signature) so a ring-graph change, a dropped
+    collective, or a signature-churn recompile fails the structural
+    gate with the program named. Needs a multi-device host: the gate
+    (scripts/perf_gate.py) forces 8 CPU devices before importing jax."""
+    from building_llm_from_scratch_tpu.configs import get_config
+    from building_llm_from_scratch_tpu.models import init_params
+    from building_llm_from_scratch_tpu.obs.compile import CompileWatcher
+    from building_llm_from_scratch_tpu.parallel import build_mesh_plan
+    from building_llm_from_scratch_tpu.training import (
+        build_optimizer,
+        init_train_state,
+        make_train_step,
+    )
+
+    if jax.device_count() < 2:
+        raise RuntimeError(
+            "micro_longctx needs >= 2 devices for the seq mesh axis; "
+            "run under XLA_FLAGS=--xla_force_host_platform_device_count=8 "
+            "(scripts/perf_gate.py sets this itself).")
+    cfg = get_config("longctx", "32k", dtype="fp32", debug=True)
+    batch_size = 4                      # divides the data axis (8/sp)
+    plan = build_mesh_plan("dp", sp=sp)
+    opt = build_optimizer(total_steps=warmup + iters + 1)
+    state = plan.shard_state(init_train_state(
+        init_params(cfg, jax.random.PRNGKey(0)), opt, jax.random.PRNGKey(0)))
+    batch = plan.shard_batch(_batch(cfg, batch_size))
+    step = CompileWatcher(make_train_step(cfg, opt, sp_mesh=plan.sp_mesh),
+                          label="longctx_step")
+    warmup, iters = _q_iters(warmup, iters)
+    dt = _time_steps(step, state, batch, warmup, iters)
+    assert step.n_recompiles == 0, step.n_recompiles
+    return _result("micro_longctx", "tokens/sec longctx-debug pretrain "
+                   f"fp32 bs{batch_size} ctx{cfg.context_length} sp{sp}",
+                   batch_size * cfg.context_length * iters / dt,
+                   unit="tokens/sec",
+                   detail={"sp": sp, "mesh": dict(plan.mesh.shape)})
+
+
+def _longctx_worker(arm: str, extra_args, timeout=1800) -> dict:
+    """Run one scripts/bench_longctx_worker.py arm (subprocess: the arm
+    needs a forced multi-device host set before jax imports; the parent
+    bench process's device count is pinned by the perf-gate baselines)."""
+    import subprocess
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    worker = os.path.join(repo, "scripts", "bench_longctx_worker.py")
+    env = dict(os.environ, JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS",
+                                                        "cpu"))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    cmd = [sys.executable, worker, "--arm", arm] + \
+        [str(a) for a in extra_args]
+    proc = subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=timeout, env=env)
+    if proc.returncode != 0:
+        raise RuntimeError(f"longctx worker ({arm}) failed rc="
+                           f"{proc.returncode}:\n{proc.stderr[-2000:]}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def bench_pretrain_longctx(ctx=1024, sp=4, steps=3, batch=4):
+    """Long-context pretrain A/B (ROADMAP item 2): the SAME batches
+    through an unsharded reference step and a sequence-sharded one
+    (dp x sp mesh, ring attention). Asserts the loss trajectories agree
+    to rtol 2e-4 — NOT bit-identical, and deliberately so: the ring's
+    online softmax reduces KV panes in ring order while the dense
+    reference reduces the full row at once, a floating-point
+    REASSOCIATION of the same sum (the pinned tolerance matches
+    tests/test_ring_attention.py's parity suite) — and that neither arm
+    recompiles after step 1. On CPU the sp arm is SLOWER (host
+    collectives, no real interconnect): the headline is the sp arm's
+    tok/s with the ref's riding as a metric; the sp>=ref throughput
+    assertion is TPU-gated."""
+    steps = max(2, min(steps, 2) if _QUICK else steps)
+    row = _longctx_worker("train", ["--sp", sp, "--ctx", ctx,
+                                    "--steps", steps, "--batch", batch])
+    rel = max(abs(a - b) / max(abs(b), 1e-9)
+              for a, b in zip(row["losses_sp"], row["losses_ref"]))
+    assert rel <= 2e-4, (rel, row)
+    assert row["recompiles_ref"] == 0, row
+    assert row["recompiles_sp"] == 0, row
+    if jax.default_backend() == "tpu":
+        # on a real pod the seq shards must buy throughput, not just fit
+        assert row["tok_s_sp"] >= row["tok_s_ref"], row
+    print(json.dumps(row), flush=True)
+    res = _result("pretrain_longctx",
+                  f"tokens/sec longctx pretrain fp32 bs{batch} "
+                  f"ctx{row['ctx']} sp{sp} vs unsharded ref",
+                  row["tok_s_sp"], unit="tokens/sec", detail=row)
+    res.add_metric("tok_s_ref", row["tok_s_ref"], "tokens/sec")
+    res.add_metric("loss_parity_max_rel", round(rel, 9), "fraction")
+    res.add_metric("recompiles_sp", row["recompiles_sp"], "count")
+    return res
+
+
+def bench_serve_longctx(sp=2, max_len=512, n_long=4, n_short=8,
+                        max_new=16):
+    """Seq-sharded prefill under mixed traffic: one sp=2 engine serving
+    interleaved long prompts (384 tokens — beyond one device's 256-token
+    pane, the admission the long-context tier exists for) and short
+    ones. Asserts zero post-warmup recompiles (the sharding constraint
+    is static — long prompts reuse the same chunk program) and reports
+    the long-vs-short TTFT split next to aggregate tok/s."""
+    if _QUICK:
+        n_long, n_short, max_new = 2, 4, 8
+    row = _longctx_worker("serve", ["--sp", sp, "--max_len", max_len,
+                                    "--n_long", n_long,
+                                    "--n_short", n_short,
+                                    "--max_new", max_new])
+    assert row["recompiles"] == 0, row
+    assert row["n_long"] == n_long and row["n_short"] == n_short, row
+    print(json.dumps(row), flush=True)
+    res = _result("serve_longctx",
+                  f"serve tokens/sec GPT2-124M sp{sp} mixed traffic "
+                  f"{n_long}long+{n_short}short maxlen{max_len}",
+                  row["tok_s"], unit="tokens/sec", detail=row)
+    res.add_metric("ttft_long_p50", row["ttft_long_p50"], "seconds")
+    res.add_metric("ttft_short_p50", row["ttft_short_p50"], "seconds")
+    res.add_metric("max_prompt", row["max_prompt"], "tokens")
+    return res
+
+
 BENCHES = {
     "headline": bench_headline,
     "cfg1": bench_cfg1,
@@ -1695,13 +1821,18 @@ BENCHES = {
     "micro_lora_fusion": bench_micro_lora_fusion,
     "micro_spec": bench_micro_spec,
     "micro_router": bench_micro_router,
+    "micro_longctx": bench_micro_longctx,
+    "pretrain_longctx": bench_pretrain_longctx,
+    "serve_longctx": bench_serve_longctx,
 }
 
 #: Micro-benches excluded from ``all`` (they are gate workloads, not
 #: performance claims — their tok/s on a debug model means nothing).
+#: micro_longctx additionally needs a multi-device host (the gate
+#: forces one; plain ``bench.py all`` runs may not have it).
 MICRO_BENCHES = ("micro_train", "micro_accum", "micro_serve",
                  "micro_paged", "micro_lora_fusion", "micro_spec",
-                 "micro_router")
+                 "micro_router", "micro_longctx")
 
 
 def _reset_compilation_cache() -> None:

@@ -100,6 +100,26 @@ def perform_checks(args) -> None:
         if args.serve_tp < 1:
             raise ValueError("--serve_tp must be >= 1 (devices per "
                              "replica; 1 = unsharded).")
+        if args.serve_sp < 1:
+            raise ValueError("--serve_sp must be >= 1 (devices the "
+                             "prefill chunk is sequence-sharded over; "
+                             "1 = unsharded).")
+        if args.serve_max_prompt < 0:
+            raise ValueError("--serve_max_prompt must be >= 0 "
+                             "(0 = auto: slot capacity / sp).")
+        if args.serve_sp > 1:
+            if args.serve_workers:
+                raise ValueError(
+                    "--serve_sp > 1 cannot ride --serve_workers: each "
+                    "worker process sees its own device set; run "
+                    "seq-sharded replicas in-process "
+                    "(--serve_replicas) instead.")
+            chunk = args.serve_prefill_chunk or 64
+            if chunk % args.serve_sp != 0:
+                raise ValueError(
+                    f"--serve_prefill_chunk {chunk} must divide evenly "
+                    f"over --serve_sp {args.serve_sp} devices: every "
+                    "device owns an equal token slice of the chunk.")
         if args.serve_max_queue < 1:
             raise ValueError("--serve_max_queue must be >= 1.")
         if args.serve_max_new_tokens < 1:
@@ -181,6 +201,7 @@ def perform_checks(args) -> None:
             ("serve_kv_quant", "model"), ("serve_prefix_budget_mb", 256.0),
             ("serve_kv_paged", "off"), ("serve_kv_page_tokens", 16),
             ("serve_spec_k", 0), ("serve_replicas", 1), ("serve_tp", 1),
+            ("serve_sp", 1), ("serve_max_prompt", 0),
             ("serve_workers", 0),
         ) if getattr(args, name) != default]
         if stray:
@@ -459,6 +480,24 @@ def get_args(argv=None):
                              "heads-sharded slot KV over a (1,1,tp) "
                              "mesh (Megatron rules, "
                              "parallel/sharding.py). 1 = unsharded.")
+    parser.add_argument("--serve_sp", type=int, default=1,
+                        help="Sequence-parallel prefill degree per serving "
+                             "replica: chunked prefill shards each chunk's "
+                             "tokens across a (1,sp,tp) mesh's seq axis so "
+                             "a prompt larger than one device's pane "
+                             "admits (the admission ceiling lifts to "
+                             "pane x sp). Decode stays on the existing "
+                             "programs; results are bit-identical to "
+                             "unsharded. Implies --serve_prefill_chunk 64 "
+                             "when unset; composes with --serve_tp and "
+                             "--serve_kv_paged. 1 = unsharded.")
+    parser.add_argument("--serve_max_prompt", type=int, default=0,
+                        help="Per-DEVICE prefill pane in prompt tokens: "
+                             "the admission ceiling is "
+                             "min(max_len-1, pane x sp), so it lifts "
+                             "with --serve_sp. 0 = auto "
+                             "(slot capacity / sp). Prompts beyond the "
+                             "ceiling get a typed rejection (HTTP 413).")
     parser.add_argument("--serve_slots", type=int, default=8,
                         help="Decode slots: the fixed batch rows the "
                              "engine keeps full (one XLA decode program "

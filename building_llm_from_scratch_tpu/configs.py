@@ -249,6 +249,35 @@ LLAMA32_CONFIG_1B = ModelConfig(
 )
 
 
+# The long-context pretrain tier (PR 20): a ~350M GQA model whose
+# NATIVE context is 32k — not a clamped-down big model. Sized so the
+# sequence dimension dominates activation memory (seq 32768 >> emb
+# 1024), which is exactly the regime sequence-parallel training
+# (--sp, ops/ring_attention.py) exists for: one device cannot hold a
+# 32k activation pane, sp shards it. rope_base 500k follows the
+# llama3 long-context recipe; no rope_scaling because 32k IS the
+# training context, not an extension of a shorter one. Train it with
+# ``--model longctx --num_params 32k --target_context_length 0`` (0
+# keeps the native 32k) or via ``bench.py pretrain_longctx``.
+LONGCTX_CONFIG_32K = ModelConfig(
+    name="longctx-32k",
+    vocab_size=50_257,
+    context_length=32_768,
+    emb_dim=1024,
+    n_heads=16,
+    n_layers=24,
+    hidden_dim=4096,
+    n_kv_groups=4,
+    norm="rmsnorm",
+    positional="rope",
+    activation="swiglu",
+    rope_base=500_000.0,
+    eos_id=50_256,
+    eos_text="<|endoftext|>",
+    dtype="bf16",
+)
+
+
 # Supported model types and their sizes (reference: utils.py:44-50)
 MODEL_PARAMS_MAPPING = {
     "GPT2": ["124M", "355M", "774M", "1.5B"],
@@ -256,6 +285,7 @@ MODEL_PARAMS_MAPPING = {
     "llama3": ["8B"],
     "llama3_1": ["8B"],
     "llama3_2": ["1B"],
+    "longctx": ["32k"],
 }
 
 _LLAMA_REGISTRY = {
@@ -263,6 +293,7 @@ _LLAMA_REGISTRY = {
     ("llama3", "8B"): LLAMA3_CONFIG_8B,
     ("llama3_1", "8B"): LLAMA31_CONFIG_8B,
     ("llama3_2", "1B"): LLAMA32_CONFIG_1B,
+    ("longctx", "32k"): LONGCTX_CONFIG_32K,
 }
 
 

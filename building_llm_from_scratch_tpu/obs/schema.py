@@ -35,7 +35,12 @@ from typing import Any, Dict, FrozenSet, List
 
 #: Bump when a row type or a load-bearing field changes meaning. The
 #: ``header`` row carries it; consumers key parsing decisions on it.
-SCHEMA_VERSION = 12         # v12: paged KV cache — page_admit /
+SCHEMA_VERSION = 13         # v13: long-context tier — prefill_shard
+                            # tick phase (seq-sharded chunk prefill,
+                            # --serve_sp), serve_warmup gains
+                            # sp / prompt_pane_tokens / max_prompt,
+                            # request_done gains long_prompt
+                            # (v12: paged KV cache — page_admit /
                             # page_share / page_release /
                             # page_pool_exhausted events (serving page
                             # pool: refcounted shared pages + page-table
@@ -73,7 +78,11 @@ ROW_TYPES = ("header", "metrics", "health", "event", "span")
 #: copies + post-prefill pane extraction, serving/kvcache.py).
 #: ``draft`` is the speculative drafter's host-side proposal time
 #: (serving/spec.py; identically 0 on spec-off engines).
-TICK_PHASES = ("admit", "prefix_copy", "prefill", "draft",
+#: ``prefill_shard`` is chunk prefill on a sequence-sharded mesh
+#: (``--serve_sp``): the same chunk pump, booked under its own phase so
+#: the long-context share of tick wall is visible (identically 0 on
+#: non-sp engines, like ``draft``).
+TICK_PHASES = ("admit", "prefix_copy", "prefill", "prefill_shard", "draft",
                "decode_dispatch", "host_fetch", "sample_commit",
                "callback_detok")
 
@@ -219,13 +228,16 @@ _EVENT_LIST: List[EventSpec] = [
           optional=("n_prompt_tokens", "n_tokens", "finish_reason", "slot",
                     "deadline_s", "queue_wait_s", "ttft_s", "tpot_s",
                     "e2e_s", "adapter", "spec_drafted", "spec_accepted",
-                    "kv_bytes_peak", "prefix_bytes_saved", "replica"),
+                    "kv_bytes_peak", "prefix_bytes_saved", "long_prompt",
+                    "replica"),
           doc="one request completed normally (latency summary; "
               "spec_drafted/spec_accepted = this request's speculative "
               "acceptance ledger on --serve_spec_k engines; "
               "kv_bytes_peak = the slot KV bytes the request occupied at "
               "its longest; prefix_bytes_saved = KV bytes prefix-cache "
-              "hits spared it from recomputing)"),
+              "hits spared it from recomputing; long_prompt = the prompt "
+              "exceeded one device's pane on a --serve_sp engine, so "
+              "prefill ran sequence-sharded)"),
     _spec("request_rejected", required=("request_id", "reason"),
           optional=("queue_depth", "replica"),
           doc="bounded queue at capacity at submit (HTTP 429)"),
@@ -336,11 +348,13 @@ _EVENT_LIST: List[EventSpec] = [
                     "max_len", "kv_quant", "prefix_cache", "prefill_chunk",
                     "kv_bytes_per_slot", "prefix_pane_tokens", "spec_k",
                     "drafter", "replica", "kv_paged", "page_tokens",
-                    "pool_pages"),
+                    "pool_pages", "sp", "prompt_pane_tokens", "max_prompt"),
           doc="prefill programs + decode (or spec verify) program "
               "compiled; watchers frozen; records the KVCachePolicy "
-              "(quant/chunk/prefix) and the speculative config "
-              "(spec_k/drafter) when on"),
+              "(quant/chunk/prefix), the speculative config "
+              "(spec_k/drafter) when on, and the seq-sharded prefill "
+              "geometry (sp/prompt_pane_tokens/max_prompt) on "
+              "--serve_sp engines"),
     _spec("serve_summary", open_fields=True,
           doc="shutdown stats snapshot (histogram percentiles, counters)"),
     _spec("serve_error", required=("error",),
@@ -353,11 +367,11 @@ _EVENT_LIST: List[EventSpec] = [
           doc="supervisor abandoned a wedged loop and restarted it"),
     # -- serving: fleet tier (serving/router.py) ---------------------------
     _spec("serve_fleet", required=("phase",),
-          optional=("n_replicas", "tp", "disjoint_devices", "n_adapters",
-                    "seconds"),
+          optional=("n_replicas", "tp", "sp", "disjoint_devices",
+                    "n_adapters", "seconds"),
           doc="router lifecycle bracketing (phase: build|end): replica "
-              "count, tensor-parallel degree, whether replicas got "
-              "disjoint device slices"),
+              "count, tensor-parallel x sequence-parallel degrees, "
+              "whether replicas got disjoint device slices"),
     _spec("replica_drain", required=("replica", "phase"),
           optional=("timeout_s", "n_active", "queue_depth",
                     "n_redispatched", "n_preempted", "seconds"),

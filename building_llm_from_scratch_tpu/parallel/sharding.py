@@ -346,38 +346,47 @@ def build_mesh_plan(shard_mode: str = "dp", *, tp: int = 1, sp: int = 1,
     return MeshPlan(mesh=mesh, shard_mode=shard_mode)
 
 
-def serve_mesh_plan(tp: int = 1, devices=None) -> MeshPlan:
-    """A serving-replica plan: ``(data=1, seq=1, model=tp)`` over exactly
-    ``tp`` devices. ``tp=1`` pins a replica to one device (the router's
-    replica-per-device layout); ``tp>1`` is the tensor-parallel engine
-    (Megatron rules over the ``model`` axis, slot KV sharded on heads)."""
+def serve_mesh_plan(tp: int = 1, sp: int = 1, devices=None) -> MeshPlan:
+    """A serving-replica plan: ``(data=1, seq=sp, model=tp)`` over exactly
+    ``sp * tp`` devices. ``tp=1, sp=1`` pins a replica to one device (the
+    router's replica-per-device layout); ``tp>1`` is the tensor-parallel
+    engine (Megatron rules over the ``model`` axis, slot KV sharded on
+    heads); ``sp>1`` is the long-context engine — chunk prefill runs with
+    its token axis sharded over ``seq`` so one replica admits prompts
+    larger than a single device's prefill pane (serving/engine.py)."""
     devices = list(devices if devices is not None else jax.devices())
-    if len(devices) < tp:
+    need = sp * tp
+    if sp < 1 or tp < 1:
+        raise ValueError(f"serve_mesh_plan needs sp >= 1 and tp >= 1 "
+                         f"(got sp={sp}, tp={tp})")
+    if len(devices) < need:
         raise ValueError(
-            f"serve_mesh_plan(tp={tp}) needs {tp} devices, have "
-            f"{len(devices)}")
-    mesh = make_mesh(data=1, seq=1, model=tp, devices=devices[:tp])
+            f"serve_mesh_plan(tp={tp}, sp={sp}) needs {need} devices, "
+            f"have {len(devices)}")
+    mesh = make_mesh(data=1, seq=sp, model=tp, devices=devices[:need])
     return MeshPlan(mesh=mesh, shard_mode="tp" if tp > 1 else "dp")
 
 
-def partition_serve_devices(n_replicas: int, tp: int = 1,
+def partition_serve_devices(n_replicas: int, tp: int = 1, sp: int = 1,
                             devices=None) -> List[List[jax.Device]]:
     """Split the device pool into one device list per serving replica.
 
-    With enough devices every replica gets a DISJOINT ``tp``-device
+    With enough devices every replica gets a DISJOINT ``sp * tp``-device
     slice (true scale-out: replicas execute concurrently). With fewer,
     replicas round-robin over overlapping slices — correct but
     device-serialized, which is still useful for tests and single-chip
-    smoke runs. ``tp`` greater than the pool is an error either way."""
+    smoke runs. ``sp * tp`` greater than the pool is an error either way."""
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
-    if tp > n:
-        raise ValueError(f"tp={tp} exceeds the {n} available devices")
+    per = sp * tp
+    if per > n:
+        raise ValueError(
+            f"tp={tp} x sp={sp} exceeds the {n} available devices")
     out = []
     for r in range(n_replicas):
-        if n >= n_replicas * tp:
-            lo = r * tp
+        if n >= n_replicas * per:
+            lo = r * per
         else:
-            lo = (r * tp) % max(n - tp + 1, 1)
-        out.append(devices[lo: lo + tp])
+            lo = (r * per) % max(n - per + 1, 1)
+        out.append(devices[lo: lo + per])
     return out

@@ -36,6 +36,7 @@ from building_llm_from_scratch_tpu.serving.kvcache import (
 )
 from building_llm_from_scratch_tpu.serving.queue import (
     EngineDrainingError,
+    PromptTooLongError,
     QueueFullError,
     RequestQueue,
     SLOShedError,
@@ -87,6 +88,7 @@ __all__ = [
     "PeerTimeoutError",
     "PrefixStore",
     "ProcessFleet",
+    "PromptTooLongError",
     "QueueFullError",
     "Request",
     "RequestExpiredError",

@@ -94,6 +94,7 @@ from building_llm_from_scratch_tpu.serving.kvcache import (
 )
 from building_llm_from_scratch_tpu.serving.queue import (
     EngineDrainingError,
+    PromptTooLongError,
     QueueFullError,
     RequestQueue,
     SLOShedError,
@@ -148,7 +149,8 @@ class DecodeEngine:
                  adapters=None,
                  kv_policy: Optional[KVCachePolicy] = None,
                  spec_k: int = 0, drafter=None,
-                 mesh_plan=None, replica: Optional[int] = None):
+                 mesh_plan=None, replica: Optional[int] = None,
+                 max_prompt: Optional[int] = None):
         import jax
 
         self.cfg = cfg
@@ -221,6 +223,56 @@ class DecodeEngine:
         #: and silently overwrite committed KV near capacity
         self._cache_len = self.max_len + self.spec_k
 
+        #: long-context tier: sequence-sharded prefill. A plan with a
+        #: live ``seq`` axis runs THE one chunk-prefill program with the
+        #: chunk's token axis sharded over ``seq`` (GSPMD gathered
+        #: attention: queries split across devices, the slot's cached KV
+        #: replicated, the chunk's new KV gathered back into the slot
+        #: row) — per-device prefill compute and activation memory drop
+        #: by sp while decode keeps the existing replicated programs.
+        #: The sharding is STATIC (part of the compiled signature), so
+        #: long/short mixed traffic never recompiles, and the math is
+        #: per-query-identical to the unsharded program, so tokens stay
+        #: bit-exact vs single-device ``generate()``.
+        self._sp = int(mesh_plan.n_seq) if mesh_plan is not None else 1
+        self._sp_sharding = None
+        if self._sp > 1:
+            if self.kv_policy.prefill_chunk <= 0:
+                raise ValueError(
+                    "sequence-sharded prefill (mesh_plan with a seq "
+                    "axis > 1) needs chunked prefill "
+                    "(KVCachePolicy.prefill_chunk > 0): the seq axis "
+                    "shards the chunk's token dimension")
+            if self.kv_policy.prefill_chunk % self._sp != 0:
+                raise ValueError(
+                    f"prefill_chunk {self.kv_policy.prefill_chunk} must "
+                    f"be divisible by the seq-parallel degree "
+                    f"{self._sp}: every device owns an equal token "
+                    "slice of the chunk")
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            from building_llm_from_scratch_tpu.parallel.mesh import (
+                SEQ_AXIS,
+            )
+
+            self._sp_sharding = NamedSharding(mesh_plan.mesh,
+                                              P(None, SEQ_AXIS))
+        #: where chunk-prefill wall books: sp engines split it out as
+        #: ``prefill_shard`` (identically 0 elsewhere, like ``draft``)
+        self._prefill_phase = ("prefill_shard" if self._sp > 1
+                               else "prefill")
+        #: per-device prefill pane in prompt tokens, and the admission
+        #: ceiling it implies. ``max_prompt`` (the --serve_max_prompt
+        #: flag) declares what ONE device's pane may prefill; the
+        #: engine-level ceiling is ``pane x sp`` — it LIFTS with the
+        #: seq-parallel degree. Default pane = slot capacity / sp, so an
+        #: unconfigured engine admits exactly what capacity allows.
+        self.prompt_pane = (int(max_prompt) if max_prompt
+                            else -(-self.max_len // self._sp))
+        self.max_prompt = min(self.max_len - 1,
+                              self.prompt_pane * self._sp)
+
         #: paged KV (``KVCachePolicy.paged``): slot rows map their
         #: logical positions onto fixed-size pages of ONE shared pool
         #: through a host-owned (n_slots, max_pages) int32 page table
@@ -234,12 +286,13 @@ class DecodeEngine:
         self.page_pool: Optional[PagePool] = None
         self._page_table: Optional[np.ndarray] = None
         if self._paged:
-            if mesh_plan is not None:
+            if mesh_plan is not None and mesh_plan.n_model > 1:
                 raise ValueError(
                     "paged KV cannot ride a tensor-parallel mesh plan "
                     "yet: the pool leaves' (n_pages, ...) layout has no "
                     "heads-sharded placement — run paged engines "
-                    "planless (replica-per-device fleets are fine)")
+                    "planless or seq-sharded only (replica-per-device "
+                    "fleets are fine)")
             self._pages_per_slot = self.kv_policy.pages_per_slot(
                 self._cache_len)
             self.page_pool = PagePool(
@@ -669,9 +722,21 @@ class DecodeEngine:
         """One C-token prefill chunk (the chunked tier's ONE compiled
         prefill program). Samples the would-be first token every call —
         the host only reads it (and the finite flag) on the FINAL chunk,
-        so non-final chunks cost zero device->host syncs."""
+        so non-final chunks cost zero device->host syncs.
+
+        Seq-sharded engines (``--serve_sp``): the chunk's token axis is
+        constrained onto the ``seq`` mesh axis and GSPMD propagates the
+        split through the whole chunk forward — each device embeds,
+        normalizes and attends its C/sp queries against the replicated
+        slot KV (per-query math identical to unsharded, so tokens stay
+        bit-exact), then the chunk's new KV is gathered back into the
+        replicated slot row by the output's pinned sharding."""
+        import jax
         import jax.numpy as jnp
 
+        if self._sp_sharding is not None:
+            tokens = jax.lax.with_sharding_constraint(tokens,
+                                                      self._sp_sharding)
         adapter = None
         if pool is not None:
             adapter = {"pool": pool, "scaling": pool_scale,
@@ -753,8 +818,15 @@ class DecodeEngine:
     def _paged_chunk_impl(self, cache, tokens, chunk_start, prompt_len,
                           slot, page_table, base_key, temp, topk,
                           pool=None, pool_scale=None, adapter_id=None):
+        import jax
         import jax.numpy as jnp
 
+        if self._sp_sharding is not None:
+            # seq-sharded chunk (see _chunk_impl): queries split over
+            # the seq axis, the page-pool KV stays replicated, the
+            # chunk's page scatters gather back via the pinned output
+            tokens = jax.lax.with_sharding_constraint(tokens,
+                                                      self._sp_sharding)
         adapter = None
         if pool is not None:
             adapter = {"pool": pool, "scaling": pool_scale,
@@ -954,8 +1026,21 @@ class DecodeEngine:
                 # e.args[0], not str(e): KeyError.__str__ reprs its
                 # message, which would wrap the 400 body in quotes
                 raise ValueError(e.args[0]) from None
+        if int(ids.size) > self.max_prompt:
+            sharded = (f" ({self.prompt_pane} tokens/device pane x "
+                       f"sp={self._sp}, seq-sharded)" if self._sp > 1
+                       else "")
+            raise PromptTooLongError(
+                f"prompt ({ids.size} tokens) exceeds the engine's "
+                f"prompt ceiling {self.max_prompt}{sharded}",
+                prompt_tokens=int(ids.size), limit=self.max_prompt,
+                pane_tokens=self.prompt_pane, sp=self._sp)
         total = int(ids.size) + params.max_new_tokens
         if total > self.max_len:
+            # plain ValueError (HTTP 400), NOT PromptTooLongError: the
+            # prompt itself fits under the ceiling — the client asked
+            # for too many NEW tokens, so shrinking max_new_tokens (not
+            # the payload) is the fix
             raise ValueError(
                 f"prompt ({ids.size}) + max_new_tokens "
                 f"({params.max_new_tokens}) = {total} exceeds the "
@@ -965,6 +1050,9 @@ class DecodeEngine:
         # a request_id on its event and close a span tree under that id,
         # or trace joins silently drop the requests that were turned away
         req = Request(next_request_id(), ids, params, on_token=on_token)
+        # long-context telemetry: a prompt no single device's pane could
+        # have prefilled alone (always False off the seq-sharded path)
+        req.long_prompt = self._sp > 1 and int(ids.size) > self.prompt_pane
         # router hop (serving/router.py): the dispatch decision precedes
         # the Request's existence, so it arrives as data and lands on the
         # span tree as a `router` child — even for requests turned away
@@ -1468,7 +1556,8 @@ class DecodeEngine:
             self.cache = cache
             st["pos"] = lo + C
             self._window_prefill_chunks += 1
-            self._tick_add("prefill", time.perf_counter() - t_pf)
+            self._tick_add(self._prefill_phase,
+                           time.perf_counter() - t_pf)
             # EARLY insertion: the moment the chunk covering the storable
             # span lands, the pane [0, span) is final — store it NOW so
             # co-admitted sharers (still mid-prefill behind us) catch up
@@ -1486,7 +1575,8 @@ class DecodeEngine:
             # the ONLY chunk that syncs (mirrors the legacy prefill)
             t_pf = time.perf_counter()
             ok_host = bool(jax.device_get(ok))
-            self._tick_add("prefill", time.perf_counter() - t_pf)
+            self._tick_add(self._prefill_phase,
+                           time.perf_counter() - t_pf)
             del self._prefill_state[slot]
             self._lengths[slot] = Tp
             if self.hooks.poison_nan(req):
@@ -1743,6 +1833,7 @@ class DecodeEngine:
         self._tick_acc_total += dt
         self.tick_seconds_total += dt
         pf = (self.tick_phase_totals["prefill"]
+              + self.tick_phase_totals["prefill_shard"]
               + self.tick_phase_totals["prefix_copy"]) - self._tick_pf0
         if pf > 0:
             self.tick_prefill_hist.observe(pf)
@@ -1767,6 +1858,7 @@ class DecodeEngine:
                 return False
             t_tick0 = time.perf_counter()
             self._tick_pf0 = (self.tick_phase_totals["prefill"]
+                              + self.tick_phase_totals["prefill_shard"]
                               + self.tick_phase_totals["prefix_copy"])
             self.hooks.before_tick(self)       # injected hang/fault point
             if self._generation != gen:
@@ -1778,6 +1870,7 @@ class DecodeEngine:
             # phases, so they are subtracted out via before/after
             # snapshots
             nested0 = (self._tick_acc["prefill"]
+                       + self._tick_acc["prefill_shard"]
                        + self._tick_acc["prefix_copy"]
                        + self._tick_acc["callback_detok"])
             t_adm0 = time.perf_counter()
@@ -1824,6 +1917,7 @@ class DecodeEngine:
                                        reason="cancelled",
                                        finish=FINISH_CANCELLED)
             nested = (self._tick_acc["prefill"]
+                      + self._tick_acc["prefill_shard"]
                       + self._tick_acc["prefix_copy"]
                       + self._tick_acc["callback_detok"]) - nested0
             self._tick_add("admit", max(
@@ -2356,6 +2450,10 @@ class DecodeEngine:
             # the RESOLVED usable pool (policy.pool_pages=0 means "sized
             # to n_slots full rows" — report what was actually built)
             kv_fields["pool_pages"] = self.page_pool.n_pages - 1
+        sp_fields = ({"sp": self._sp,
+                      "prompt_pane_tokens": self.prompt_pane,
+                      "max_prompt": self.max_prompt}
+                     if self._sp > 1 else {})
         self._ev(
             "serve_warmup", n_prefill_buckets=len(buckets),
             buckets=buckets, seconds=round(time.monotonic() - t0, 3),
@@ -2364,7 +2462,7 @@ class DecodeEngine:
             prefix_pane_tokens=(self._prefix_pane_len
                                 if self.prefix_store is not None
                                 else None),
-            **kv_fields, **spec_fields)
+            **kv_fields, **spec_fields, **sp_fields)
         logger.info(
             "Serving warmup: %s + 1 %s program in %.2fs (kv %s, "
             "%.2f MiB/slot%s%s)",

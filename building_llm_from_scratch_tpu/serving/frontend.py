@@ -40,6 +40,7 @@ from typing import List, Optional
 from building_llm_from_scratch_tpu.serving.engine import DecodeEngine
 from building_llm_from_scratch_tpu.serving.queue import (
     EngineDrainingError,
+    PromptTooLongError,
     QueueFullError,
     SLOShedError,
 )
@@ -284,6 +285,13 @@ def make_http_server(engine: DecodeEngine, port: int,
                     "error": "request queue full — retry later",
                     "queue_capacity": engine.queue_capacity()},
                     retry_after=engine.estimate_queue_clear_s() or 1.0)
+            except PromptTooLongError as e:
+                # 413: the client must shorten the payload, not retry
+                # it. `max_prompt` is the seq-sharded ceiling on
+                # --serve_sp engines (pane x sp).
+                return self._json(413, {
+                    "error": str(e), "max_prompt": e.limit,
+                    "prompt_tokens": e.prompt_tokens})
             except ValueError as e:
                 return self._json(400, {"error": str(e)})
             except RuntimeError as e:           # engine is dead
@@ -342,11 +350,14 @@ def run_serve(args, comps, metric_logger) -> DecodeEngine:
 
     prefix_on = getattr(args, "serve_prefix_cache", "off") == "on"
     paged_on = getattr(args, "serve_kv_paged", "off") == "on"
+    serve_sp = getattr(args, "serve_sp", 1)
     chunk = getattr(args, "serve_prefill_chunk", 0)
-    if (prefix_on or paged_on) and chunk <= 0:
-        chunk = 64          # prefix caching/paging imply chunked prefill
+    if (prefix_on or paged_on or serve_sp > 1) and chunk <= 0:
+        chunk = 64          # these paths all imply chunked prefill
         logger.info("--serve_%s on: defaulting --serve_prefill_chunk "
-                    "to 64.", "prefix_cache" if prefix_on else "kv_paged")
+                    "to 64.",
+                    "prefix_cache" if prefix_on
+                    else ("kv_paged" if paged_on else "sp"))
     kv_policy = KVCachePolicy(
         kv_quant=getattr(args, "serve_kv_quant", "model"),
         prefix_cache=prefix_on,
@@ -358,6 +369,7 @@ def run_serve(args, comps, metric_logger) -> DecodeEngine:
     )
     n_replicas = getattr(args, "serve_replicas", 1)
     serve_tp = getattr(args, "serve_tp", 1)
+    max_prompt = getattr(args, "serve_max_prompt", 0) or None
     n_workers = getattr(args, "serve_workers", 0)
     if n_workers > 0:
         # cross-process fleet (serving/fleet.py): N supervised worker
@@ -391,7 +403,8 @@ def run_serve(args, comps, metric_logger) -> DecodeEngine:
                 default_deadline_s=(args.serve_deadline_s or None),
                 tick_timeout_s=args.serve_tick_timeout,
                 max_restarts=args.serve_max_restarts,
-                metrics_every=args.serve_metrics_every),
+                metrics_every=args.serve_metrics_every,
+                max_prompt=max_prompt),
             kv_policy=dict(
                 kv_quant=kv_policy.kv_quant,
                 prefix_cache=kv_policy.prefix_cache,
@@ -425,7 +438,8 @@ def run_serve(args, comps, metric_logger) -> DecodeEngine:
                  if getattr(args, "serve_adapters", None) else None)
         engine = EngineRouter.build(
             comps.cfg, comps.params, comps.tokenizer,
-            n_replicas=n_replicas, tp=serve_tp,
+            n_replicas=n_replicas, tp=serve_tp, sp=serve_sp,
+            max_prompt=max_prompt,
             adapter_specs=specs,
             adapter_capacity=args.serve_adapter_slots,
             kv_policy=kv_policy,
@@ -479,15 +493,17 @@ def run_serve(args, comps, metric_logger) -> DecodeEngine:
                     ", ".join(adapters.names()), adapters.capacity)
 
     mesh_plan = None
-    if serve_tp > 1:
-        # single tp-sharded replica: the whole compiled program family
-        # runs with NamedSharding'd weights + heads-sharded slot KV over
-        # the `model` mesh axis (parallel/sharding.serve_mesh_plan)
+    if serve_tp > 1 or serve_sp > 1:
+        # single sharded replica: tp shards the whole compiled program
+        # family (NamedSharding'd weights + heads-sharded slot KV over
+        # the `model` mesh axis); sp sequence-shards chunk prefill over
+        # the `seq` axis so long prompts admit beyond one device's pane
+        # (parallel/sharding.serve_mesh_plan — the two compose)
         from building_llm_from_scratch_tpu.parallel.sharding import (
             serve_mesh_plan,
         )
 
-        mesh_plan = serve_mesh_plan(serve_tp)
+        mesh_plan = serve_mesh_plan(serve_tp, sp=serve_sp)
     engine = DecodeEngine(
         comps.cfg, comps.params, comps.tokenizer,
         n_slots=args.serve_slots,
@@ -503,6 +519,7 @@ def run_serve(args, comps, metric_logger) -> DecodeEngine:
         kv_policy=kv_policy,
         spec_k=getattr(args, "serve_spec_k", 0),
         mesh_plan=mesh_plan,
+        max_prompt=max_prompt,
     )
     stall = None
     if args.stall_timeout > 0 and engine.supervisor is None:

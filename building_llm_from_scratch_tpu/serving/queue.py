@@ -44,6 +44,26 @@ class EngineDrainingError(Exception):
         self.retry_after_s = retry_after_s
 
 
+class PromptTooLongError(ValueError):
+    """The prompt (or prompt + decode budget) exceeds what this engine can
+    admit. A ``ValueError`` subclass so callers that mapped the old
+    generic rejection keep working, but typed so the HTTP frontend can
+    answer 413 (the client must shorten the payload, not retry it).
+
+    ``limit`` is the engine's admission ceiling in prompt tokens; on a
+    sequence-sharded engine (``--serve_sp``) it is the SEQ-SHARDED
+    ceiling — ``pane_tokens`` per device x ``sp`` devices — so the error
+    reports how far the long-context path actually lifted admission."""
+
+    def __init__(self, msg: str, *, prompt_tokens: int, limit: int,
+                 pane_tokens: Optional[int] = None, sp: int = 1):
+        super().__init__(msg)
+        self.prompt_tokens = prompt_tokens
+        self.limit = limit
+        self.pane_tokens = pane_tokens
+        self.sp = sp
+
+
 class RequestQueue:
     def __init__(self, max_size: int = 64):
         if max_size < 1:

@@ -116,6 +116,10 @@ class Request:
         # and the KV bytes prefix-cache hits spared it from recomputing
         self.kv_bytes_peak = 0
         self.prefix_bytes_saved = 0
+        # long-context tier: prompt longer than one device's prefill pane
+        # (set at submit by a --serve_sp engine; the long-vs-short TTFT
+        # split in summarize_metrics keys on it)
+        self.long_prompt = False
         # timestamps (time.monotonic): submit -> admit (queue wait) ->
         # first token (TTFT) -> finish (TPOT over the decode tail).
         # wall_submit anchors the monotonic timeline to unix time so the
@@ -225,6 +229,8 @@ class Request:
             out["kv_bytes_peak"] = self.kv_bytes_peak
         if self.prefix_bytes_saved:
             out["prefix_bytes_saved"] = self.prefix_bytes_saved
+        if self.long_prompt:
+            out["long_prompt"] = True
         for name, fn in (("queue_wait_s", self.queue_wait_s),
                          ("ttft_s", self.ttft_s), ("tpot_s", self.tpot_s),
                          ("e2e_s", self.e2e_s)):

@@ -118,7 +118,7 @@ class EngineRouter:
 
     @classmethod
     def build(cls, cfg, params, tokenizer=None, *, n_replicas: int,
-              tp: int = 1, devices=None,
+              tp: int = 1, sp: int = 1, devices=None,
               adapter_specs: Optional[Dict[str, str]] = None,
               adapter_capacity: int = 0,
               kv_policy=None, watch_compiles: str = "all",
@@ -152,7 +152,7 @@ class EngineRouter:
         if watch_compiles not in ("all", "first", "none"):
             raise ValueError("watch_compiles must be all|first|none")
         t0 = time.monotonic()
-        dev_slices = partition_serve_devices(n_replicas, tp,
+        dev_slices = partition_serve_devices(n_replicas, tp, sp,
                                              devices=devices)
         specs = dict(adapter_specs or {})
         names = sorted(specs)
@@ -160,7 +160,7 @@ class EngineRouter:
             adapter_capacity = max(2, len(names) + 1)
 
         def make_engine(i: int) -> DecodeEngine:
-            plan = serve_mesh_plan(tp, devices=dev_slices[i])
+            plan = serve_mesh_plan(tp, sp, devices=dev_slices[i])
             registry = None
             if adapter_specs is not None:
                 # an EMPTY spec dict still builds (empty) registries:
@@ -183,14 +183,14 @@ class EngineRouter:
         router = cls(engines, adapter_paths=specs, factory=make_engine,
                      prefix_affinity=prefix_affinity)
         disjoint = (len({d for sl in dev_slices for d in sl})
-                    == n_replicas * tp)
+                    == n_replicas * tp * sp)
         get_metrics().event(
             "serve_fleet", phase="build", n_replicas=n_replicas, tp=tp,
-            disjoint_devices=disjoint, n_adapters=len(names),
+            sp=sp, disjoint_devices=disjoint, n_adapters=len(names),
             seconds=round(time.monotonic() - t0, 3))
         logger.info(
-            "Fleet: %d replica(s) x tp=%d (%s device slices), %d "
-            "adapter(s) round-robin.", n_replicas, tp,
+            "Fleet: %d replica(s) x tp=%d x sp=%d (%s device slices), %d "
+            "adapter(s) round-robin.", n_replicas, tp, sp,
             "disjoint" if disjoint else "OVERLAPPING", len(names))
         return router
 

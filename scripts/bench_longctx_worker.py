@@ -1,0 +1,199 @@
+"""Worker process for ``bench.py pretrain_longctx`` / ``serve_longctx``.
+
+A subprocess because both arms need a multi-device host
+(``--xla_force_host_platform_device_count``, set BEFORE jax imports) and
+the parent bench process's device count is pinned by the perf-gate
+baselines. The engine/host scaffolding lives in ``serving/worker.py``
+(``apply_host_env``) — one worker implementation for bench and fleet.
+
+Two arms, selected by ``--arm``:
+
+  - ``train``: the long-context pretrain A/B. The SAME batches run
+    through an unsharded reference ``make_train_step`` and a
+    sequence-sharded one (``build_mesh_plan("dp", sp=N)`` routes
+    attention through the ring schedule, ops/ring_attention.py). Prints
+    both loss trajectories and both CompileWatcher recompile counts so
+    the parent can assert parity and compile stability. The losses are
+    NOT bit-identical: the ring's online-softmax reduces KV panes in
+    ring order while the dense oracle reduces the full row at once, a
+    floating-point reassociation — the parent pins rtol 2e-4 (the same
+    tolerance tests/test_ring_attention.py pins), not equality.
+  - ``serve``: seq-sharded prefill under mixed traffic. One sp=N engine
+    serves interleaved long prompts (> one device's pane) and short
+    ones; prints the TTFT split, the post-warmup recompile count (must
+    be 0 — the sharding constraint is static) and aggregate tok/s.
+
+Prints ONE JSON line on stdout; diagnostics go to stderr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _train(args) -> dict:
+    import time
+
+    import jax
+    import numpy as np
+
+    from building_llm_from_scratch_tpu.configs import get_config
+    from building_llm_from_scratch_tpu.models import init_params
+    from building_llm_from_scratch_tpu.obs.compile import CompileWatcher
+    from building_llm_from_scratch_tpu.parallel import build_mesh_plan
+    from building_llm_from_scratch_tpu.training import (
+        build_optimizer,
+        init_train_state,
+        make_train_step,
+    )
+
+    # the longctx-32k architecture (GQA + rope 500k + swiglu) scaled to
+    # CPU A/B size: the 32k context itself is the TPU workload — here the
+    # ring schedule, the mesh and the step graph are what's exercised.
+    # fp32 so the parity bound is the ring REASSOCIATION, not bf16 eps.
+    cfg = get_config("longctx", "32k", target_context_length=None).replace(
+        context_length=args.ctx, emb_dim=64, n_layers=2, n_heads=4,
+        n_kv_groups=2, hidden_dim=128, vocab_size=512, drop_rate=0.0,
+        dtype="fp32")
+    rng = np.random.default_rng(0)
+    batches = []
+    for _ in range(args.steps):
+        x = rng.integers(0, cfg.vocab_size,
+                         (args.batch, cfg.context_length)).astype(np.int32)
+        batches.append({"inputs": x, "targets": np.roll(x, -1, 1),
+                        "weights": np.ones_like(x, np.float32)})
+
+    def run(sp):
+        opt = build_optimizer(peak_lr=1e-3, warmup_steps=2,
+                              total_steps=args.steps + 2)
+        state = init_train_state(init_params(cfg, jax.random.PRNGKey(0)),
+                                 opt, jax.random.PRNGKey(0))
+        if sp > 1:
+            plan = build_mesh_plan("dp", sp=sp)
+            state = plan.shard_state(state)
+            step = CompileWatcher(
+                make_train_step(cfg, opt, sp_mesh=plan.sp_mesh),
+                label=f"longctx_sp{sp}")
+            shard = plan.shard_batch
+        else:
+            step = CompileWatcher(make_train_step(cfg, opt),
+                                  label="longctx_ref")
+            shard = lambda b: b               # noqa: E731
+        losses, t0 = [], None
+        for i, b in enumerate(batches):
+            state, m = step(state, shard(b))
+            losses.append(float(m["loss"]))   # blocks on the step
+            if i == 0:
+                t0 = time.perf_counter()      # steps 2..N: steady state
+        dt = time.perf_counter() - t0
+        toks = args.batch * cfg.context_length * (args.steps - 1)
+        return losses, step.n_recompiles, toks / dt if dt > 0 else 0.0
+
+    losses_ref, rec_ref, tps_ref = run(1)
+    losses_sp, rec_sp, tps_sp = run(args.sp)
+    return {
+        "ctx": cfg.context_length, "sp": args.sp, "batch": args.batch,
+        "steps": args.steps, "devices": jax.device_count(),
+        "losses_ref": losses_ref, "losses_sp": losses_sp,
+        "recompiles_ref": rec_ref, "recompiles_sp": rec_sp,
+        "tok_s_ref": round(tps_ref, 1), "tok_s_sp": round(tps_sp, 1),
+    }
+
+
+def _serve(args) -> dict:
+    import time
+
+    import jax
+    import numpy as np
+
+    from building_llm_from_scratch_tpu.configs import get_config
+    from building_llm_from_scratch_tpu.models import init_params
+    from building_llm_from_scratch_tpu.parallel.sharding import (
+        serve_mesh_plan,
+    )
+    from building_llm_from_scratch_tpu.serving import (
+        DecodeEngine,
+        KVCachePolicy,
+        SamplingParams,
+    )
+
+    dtype = "bf16" if jax.default_backend() == "tpu" else "fp32"
+    cfg = get_config("GPT2", "124M", dtype=dtype)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    plan = serve_mesh_plan(sp=args.sp)
+    pane = -(-args.max_len // args.sp)
+    engine = DecodeEngine(
+        cfg, params, n_slots=args.slots, max_len=args.max_len,
+        max_queue=args.n_long + args.n_short, mesh_plan=plan,
+        kv_policy=KVCachePolicy(prefill_chunk=args.chunk),
+        metrics_every=8)
+    engine.warmup()
+    engine.start()
+    rng = np.random.default_rng(0)
+    # long prompts exceed one device's pane (the admission the sp tier
+    # exists for); shorts interleave so the TTFT split is apples-to-
+    # apples within one mixed-traffic run
+    sizes = []
+    for i in range(args.n_long + args.n_short):
+        sizes.append(args.long_len if i % 2 == 0 and
+                     sizes.count(args.long_len) < args.n_long
+                     else args.short_len)
+    assert max(sizes) > pane, (sizes, pane)
+    sp_params = SamplingParams(max_new_tokens=args.max_new, ignore_eos=True)
+    t0 = time.perf_counter()
+    handles = [engine.submit(
+        rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32), sp_params,
+        block=True) for n in sizes]
+    engine.run_until_idle()
+    dt = time.perf_counter() - t0
+    long_ttft, short_ttft, n_tokens = [], [], 0
+    for h, n in zip(handles, sizes):
+        assert len(h.output_ids) == args.max_new, h.finish_reason
+        n_tokens += len(h.output_ids)
+        s = h.summary()
+        (long_ttft if n > pane else short_ttft).append(s["ttft_s"])
+        assert bool(s.get("long_prompt")) == (n > pane), s
+    recompiles = engine.n_recompiles
+    engine.shutdown()
+    return {
+        "sp": args.sp, "pane": pane, "max_prompt": engine.max_prompt,
+        "max_len": args.max_len, "devices": jax.device_count(),
+        "n_long": len(long_ttft), "n_short": len(short_ttft),
+        "ttft_long_p50": round(float(np.median(long_ttft)), 4),
+        "ttft_short_p50": round(float(np.median(short_ttft)), 4),
+        "recompiles": recompiles,
+        "tok_s": round(n_tokens / dt, 1),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--arm", choices=("train", "serve"), required=True)
+    ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--sp", type=int, default=4)
+    # train arm
+    ap.add_argument("--ctx", type=int, default=2048)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--steps", type=int, default=3)
+    # serve arm
+    ap.add_argument("--max_len", type=int, default=512)
+    ap.add_argument("--chunk", type=int, default=64)
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--n_long", type=int, default=4)
+    ap.add_argument("--n_short", type=int, default=8)
+    ap.add_argument("--long_len", type=int, default=384)
+    ap.add_argument("--short_len", type=int, default=32)
+    ap.add_argument("--max_new", type=int, default=16)
+    args = ap.parse_args()
+
+    from building_llm_from_scratch_tpu.serving.worker import apply_host_env
+
+    apply_host_env(args.devices)
+    out = _train(args) if args.arm == "train" else _serve(args)
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

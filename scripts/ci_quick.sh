@@ -861,6 +861,52 @@ print(f"fleet exporter ok: {meta['n_request_spans']} request trees, "
 EOF
 echo "fleet exporter ok"
 
+echo "== long-prompt serve smoke (seq-sharded prefill, sp=2, CPU) =="
+JAX_PLATFORMS=cpu python - <<'EOF' || exit 1
+import json, os, subprocess, sys, tempfile
+d = tempfile.mkdtemp()
+# Seq-sharded prefill through the REAL CLI. The --debug model's context
+# is 16 tokens, so with --serve_sp 2 each device owns an 8-token pane:
+# the 12-byte prompts below exceed one pane and are only admissible
+# because prefill chunks are sharded across the seq mesh axis. A
+# subprocess (unlike the in-process smokes above) because the seq axis
+# needs an 8-device forced host, set via XLA_FLAGS before jax imports.
+reqs = os.path.join(d, "requests.jsonl")
+with open(reqs, "w") as f:
+    for i in range(6):
+        f.write(json.dumps({"prompt": "hello world!" if i % 2 else "hi",
+                            "max_new_tokens": 3,
+                            "ignore_eos": True, "seed": i}) + "\n")
+out = os.path.join(d, "results.jsonl")
+mj = os.path.join(d, "metrics.jsonl")
+env = dict(os.environ, JAX_PLATFORMS="cpu",
+           XLA_FLAGS="--xla_force_host_platform_device_count=8")
+proc = subprocess.run(
+    [sys.executable, "-m", "building_llm_from_scratch_tpu",
+     "--mode", "serve", "--debug", "--byte_tokenizer", "--data_dir", d,
+     "--serve_prompts", reqs, "--serve_out", out,
+     "--serve_slots", "2", "--serve_max_queue", "6",
+     "--serve_sp", "2", "--serve_prefill_chunk", "8",
+     "--metrics_jsonl", mj],
+    env=env, capture_output=True, text=True, timeout=600)
+assert proc.returncode == 0, f"serve rc={proc.returncode}:\n" \
+    f"{proc.stdout}\n{proc.stderr}"
+results = [json.loads(l) for l in open(out)]
+assert len(results) == 6, f"expected 6 results, got {len(results)}"
+assert all(r["finish_reason"] == "length" for r in results), results
+rows = [json.loads(l) for l in open(mj)]
+warm = [r for r in rows if r.get("event") == "serve_warmup"][0]
+assert warm["sp"] == 2 and warm["prompt_pane_tokens"] == 8, warm
+assert warm["max_prompt"] == 15, warm
+done = [r for r in rows if r.get("event") == "request_done"]
+longs = [r for r in done if r.get("long_prompt")]
+assert len(longs) == 3, f"expected 3 long-prompt requests: {done}"
+assert not [r for r in rows if r.get("event") == "recompile"], "recompile"
+print(f"long-prompt serve smoke ok: 6/6 requests (3 beyond one "
+      f"device's {warm['prompt_pane_tokens']}-token pane), sp=2 x 8 "
+      f"devices, prompt ceiling {warm['max_prompt']}, 0 recompiles")
+EOF
+
 echo "== perf observatory gate (structural, timing-free, CPU) =="
 # The three debug-size micro-benches' structural HLO fingerprints —
 # per-program cost-analysis FLOPs, compiled-program count, arg

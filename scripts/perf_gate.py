@@ -42,6 +42,17 @@ import sys
 import tempfile
 import time
 
+# The gate benches run IN-PROCESS, and micro_longctx needs a multi-
+# device host for its seq mesh axis — force 8 CPU devices before any
+# jax import (same count the tests and the fleet workers pin; the
+# structural fingerprints are device-count-insensitive for the
+# single-device benches, and the baseline env records 8).
+if "--xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8").strip()
+
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO_ROOT)
 # summarize_metrics (the telemetry-diff view) lives next to this script;
@@ -82,11 +93,13 @@ BASELINE_JSONL_DIR = os.path.join(REPO_ROOT, "results", "perf", "baseline")
 #: one speculative (k=4 verify) engine run, one fleet-router run —
 #: together they fingerprint the train step builder, the serving
 #: engine's whole program family (plain decode AND spec verify tiers),
-#: the fused-finetune step, and the router path's PER-REPLICA program
-#: family (watch_compiles="first": replica-count invariant).
+#: the fused-finetune step, the router path's PER-REPLICA program
+#: family (watch_compiles="first": replica-count invariant), and the
+#: sequence-sharded ring-attention train step (micro_longctx — the
+#: long-context tier, needing the forced 8-device host above).
 GATE_BENCHES = ("micro_train", "micro_accum", "micro_serve",
                 "micro_paged", "micro_lora_fusion", "micro_spec",
-                "micro_router")
+                "micro_router", "micro_longctx")
 
 #: Env fields whose drift invalidates structural comparability (a
 #: different XLA counts different FLOPs) — reported, not silently eaten.

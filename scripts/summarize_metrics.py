@@ -228,6 +228,7 @@ def summarize_serving(metrics, events):
     summarize_adapters(done, failed, events)
     summarize_prefix_kv(metrics, events)
     summarize_spec(done, metrics, events)
+    summarize_longctx(done, metrics, events)
     for key, label in (("queue_wait_s", "queue wait"), ("ttft_s", "TTFT"),
                        ("tpot_s", "TPOT"), ("e2e_s", "end-to-end")):
         vals = [e[key] for e in done
@@ -533,6 +534,44 @@ def summarize_adapters(done, failed, events):
         print(line)
 
 
+def summarize_longctx(done, metrics, events):
+    """Long-context tier section (--serve_sp): the seq-sharded prefill
+    geometry from ``serve_warmup`` (sp x per-device pane = the lifted
+    admission ceiling), the ``prefill_shard`` share of tick wall (what
+    sequence-sharding the chunk pump actually costs per tick), and the
+    long-vs-short TTFT split from the ``long_prompt``-flagged
+    ``request_done`` rows — the number that says what a beyond-one-pane
+    prompt pays over a short one."""
+    warm = [e for e in events if e["event"] == "serve_warmup"
+            and isinstance(e.get("sp"), (int, float)) and e["sp"] > 1]
+    long_done = [e for e in done if e.get("long_prompt")]
+    if not (warm or long_done):
+        return
+    print("  -- long context (seq-sharded prefill) --")
+    if warm:
+        w = warm[-1]
+        print(f"    sp={int(w['sp'])} x {w.get('prompt_pane_tokens')} "
+              f"tokens/device pane -> prompt ceiling "
+              f"{w.get('max_prompt')}")
+    rows = [r for r in metrics
+            if isinstance(r.get("tick_prefill_shard_s"), (int, float))
+            and isinstance(r.get("tick_total_s"), (int, float))]
+    shard = sum(r["tick_prefill_shard_s"] for r in rows)
+    total = sum(r["tick_total_s"] for r in rows)
+    if total > 0 and shard > 0:
+        print(f"    prefill_shard: {100 * shard / total:.1f}% of tick "
+              "time (the seq-sharded chunk pump)")
+    short_done = [e for e in done if not e.get("long_prompt")]
+    for label, grp in (("long (> pane)", long_done),
+                       ("short", short_done)):
+        ttfts = [e["ttft_s"] for e in grp
+                 if isinstance(e.get("ttft_s"), (int, float))]
+        if ttfts:
+            print(f"    {label:<14} {len(grp):3d} req   TTFT p50 "
+                  f"{1e3 * _pctile(ttfts, 50):8.2f} ms   p95 "
+                  f"{1e3 * _pctile(ttfts, 95):8.2f} ms")
+
+
 def summarize_spec(done, metrics, events):
     """Speculative-decoding section (serving/spec.py): the drafter
     config from ``serve_warmup``, the fleet-wide acceptance ratio
@@ -749,7 +788,10 @@ def summarize_ticks(metrics, events):
                       f" ms   p95 {1e3 * _pctile(per_tick, 95):8.3f} ms")
         total = sum(r["tick_total_s"] for r in rows)
         if total > 0:
-            pf, dec = sums.get("prefill", 0), sums.get("decode_dispatch", 0)
+            # prefill_shard is the seq-sharded chunk pump (--serve_sp):
+            # same head-of-line economics, booked under its own phase
+            pf = sums.get("prefill", 0) + sums.get("prefill_shard", 0)
+            dec = sums.get("decode_dispatch", 0)
             line = (f"    prefill {100 * pf / total:.1f}% vs decode "
                     f"{100 * dec / total:.1f}% of tick time")
             if pf > dec:

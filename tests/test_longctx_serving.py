@@ -1,0 +1,310 @@
+"""Long-context serving tier (--serve_sp): sequence-sharded chunk
+prefill on the 8-device CPU mesh.
+
+The claim under test: sharding each prefill chunk's tokens across the
+``seq`` mesh axis is placement, not semantics — prompts larger than one
+device's pane admit, the produced tokens are BIT-IDENTICAL to the
+unsharded engine and to one-shot ``generate()``, the compiled program
+set never grows under mixed long/short traffic, and the tier composes
+with paged KV + int8 (byte-exact ledger included). Admission failures
+are typed (``PromptTooLongError`` — the HTTP 413) and report the
+seq-sharded ceiling.
+"""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from building_llm_from_scratch_tpu.configs import ModelConfig
+from building_llm_from_scratch_tpu.generate import generate
+from building_llm_from_scratch_tpu.models import init_params
+from building_llm_from_scratch_tpu.obs import configure_metrics
+from building_llm_from_scratch_tpu.parallel.sharding import serve_mesh_plan
+from building_llm_from_scratch_tpu.serving import (
+    DecodeEngine,
+    KVCachePolicy,
+    PromptTooLongError,
+    SamplingParams,
+)
+from building_llm_from_scratch_tpu.serving.kvcache import cache_nbytes
+
+
+def tiny_cfg(ctx=64, **kw):
+    base = dict(name="longctx-tiny", vocab_size=96, context_length=ctx,
+                emb_dim=32, n_heads=2, n_layers=2, hidden_dim=64,
+                n_kv_groups=2, norm="layernorm", positional="learned",
+                activation="gelu", drop_rate=0.0, eos_id=1)
+    base.update(kw)
+    return ModelConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_cfg()
+    return cfg, init_params(cfg, jax.random.PRNGKey(0))
+
+
+@pytest.fixture
+def sink(tmp_path):
+    path = tmp_path / "metrics.jsonl"
+    logger = configure_metrics(str(path), run_metadata={"test": True})
+    yield str(path)
+    logger.close()
+    configure_metrics(None)
+
+
+def solo_tokens(params, cfg, prompt, sp: SamplingParams):
+    """One-shot generate() with the matching seed/params — the engine's
+    bit-parity oracle (same idiom as test_serving.py)."""
+    out, n = generate(params, cfg, np.asarray(prompt)[None],
+                      max_new_tokens=sp.max_new_tokens,
+                      temperature=sp.temperature, top_k=sp.top_k,
+                      eos_id=(None if sp.ignore_eos
+                              else (sp.eos_id if sp.eos_id is not None
+                                    else cfg.eos_id)),
+                      rng=jax.random.PRNGKey(sp.seed),
+                      return_n_generated=True)
+    Tp = len(prompt)
+    return [int(t) for t in out[0, Tp: Tp + int(n[0])]]
+
+
+def sp_engine(cfg, params, sp=2, chunk=8, **kw):
+    kw.setdefault("n_slots", 2)
+    kw.setdefault("metrics_every", 4)
+    pol = kw.pop("kv_policy", None) or KVCachePolicy(prefill_chunk=chunk)
+    eng = DecodeEngine(cfg, params, n_slots=kw.pop("n_slots"),
+                       mesh_plan=serve_mesh_plan(sp=sp), kv_policy=pol,
+                       **kw)
+    return eng
+
+
+def run_engine(eng, prompts, params_list):
+    eng.warmup()
+    eng.start()
+    handles = [eng.submit(p, s, block=True)
+               for p, s in zip(prompts, params_list)]
+    eng.run_until_idle()
+    out = [[int(t) for t in h.output_ids] for h in handles]
+    return out, handles
+
+
+# ---------------------------------------------------------------------------
+# pane geometry + typed admission
+# ---------------------------------------------------------------------------
+
+def test_pane_lifts_with_sp(model):
+    """The admission ceiling is min(max_len-1, pane x sp): an sp=2
+    engine admits prompts DOUBLE one device's pane (up to the slot)."""
+    cfg, params = model
+    eng = sp_engine(cfg, params, sp=2, chunk=8, max_len=32)
+    assert eng.prompt_pane == 16            # ceil(32 / 2) per device
+    assert eng.max_prompt == 31             # pane x sp clamped to slot-1
+    ref = DecodeEngine(cfg, params, n_slots=2, max_len=32,
+                       kv_policy=KVCachePolicy(prefill_chunk=8))
+    assert ref.prompt_pane == 32            # unsharded: pane IS the slot
+    assert ref.max_prompt == 31
+    eng.shutdown()
+    ref.shutdown()
+
+
+def test_explicit_pane_cap(model):
+    """--serve_max_prompt pins the per-device pane; the ceiling is
+    pane x sp."""
+    cfg, params = model
+    eng = sp_engine(cfg, params, sp=2, chunk=8, max_len=32, max_prompt=10)
+    assert eng.prompt_pane == 10
+    assert eng.max_prompt == 20
+    eng.shutdown()
+
+
+def test_prompt_too_long_typed_rejection(model):
+    """Over-ceiling prompts raise PromptTooLongError carrying the
+    seq-sharded ceiling breakdown (pane_tokens x sp)."""
+    cfg, params = model
+    eng = sp_engine(cfg, params, sp=2, chunk=8, max_len=32, max_prompt=10)
+    eng.warmup()
+    with pytest.raises(PromptTooLongError) as ei:
+        eng.submit(np.arange(24, dtype=np.int32) % cfg.vocab_size,
+                   SamplingParams(max_new_tokens=2))
+    err = ei.value
+    assert err.prompt_tokens == 24
+    assert err.limit == 20
+    assert err.pane_tokens == 10
+    assert err.sp == 2
+    assert "seq-sharded" in str(err)
+    assert isinstance(err, ValueError)      # old callers keep working
+    eng.shutdown()
+
+
+def test_sp_requires_chunked_prefill(model):
+    cfg, params = model
+    with pytest.raises(ValueError, match="chunked prefill"):
+        DecodeEngine(cfg, params, n_slots=2,
+                     mesh_plan=serve_mesh_plan(sp=2))
+    with pytest.raises(ValueError, match="equal token slice"):
+        DecodeEngine(cfg, params, n_slots=2,
+                     mesh_plan=serve_mesh_plan(sp=2),
+                     kv_policy=KVCachePolicy(prefill_chunk=9))
+
+
+# ---------------------------------------------------------------------------
+# bit-parity + zero recompiles
+# ---------------------------------------------------------------------------
+
+def test_long_prompt_matches_generate_bit_exact(model):
+    """A prompt LARGER than one device's pane, prefilled seq-sharded,
+    produces the exact token sequence of one-shot generate() AND of the
+    unsharded engine — greedy and sampled."""
+    cfg, params = model
+    rng = np.random.default_rng(0)
+    # pane = ceil(64/2) = 32; 40-token prompts exceed it
+    prompts = [rng.integers(0, cfg.vocab_size, (40,)).astype(np.int32)
+               for _ in range(3)]
+    sps = [SamplingParams(max_new_tokens=6, ignore_eos=True),
+           SamplingParams(max_new_tokens=6, temperature=0.9, top_k=20,
+                          seed=11, ignore_eos=True),
+           SamplingParams(max_new_tokens=6, temperature=0.7, seed=5,
+                          ignore_eos=True)]
+    eng = sp_engine(cfg, params, sp=2, chunk=8)
+    got, handles = run_engine(eng, prompts, sps)
+    assert eng.n_recompiles == 0
+    for h in handles:
+        assert h.long_prompt                # > one pane -> flagged
+    eng.shutdown()
+
+    for out, p, s in zip(got, prompts, sps):
+        assert out == solo_tokens(params, cfg, p, s)
+
+    ref = DecodeEngine(cfg, params, n_slots=2,
+                       kv_policy=KVCachePolicy(prefill_chunk=8))
+    ref_out, _ = run_engine(ref, prompts, sps)
+    ref.shutdown()
+    assert got == ref_out
+
+
+def test_mixed_traffic_zero_recompiles(model):
+    """Interleaved long (> pane) and short prompts reuse one compiled
+    chunk program + one decode program: n_recompiles stays 0 and no new
+    programs appear after warmup freeze."""
+    cfg, params = model
+    rng = np.random.default_rng(1)
+    prompts, sps = [], []
+    for i in range(8):
+        n = 40 if i % 2 == 0 else 5
+        prompts.append(rng.integers(0, cfg.vocab_size, (n,))
+                       .astype(np.int32))
+        sps.append(SamplingParams(max_new_tokens=4, seed=i,
+                                  temperature=(0.8 if i % 3 == 0 else 0.0),
+                                  ignore_eos=True))
+    eng = sp_engine(cfg, params, sp=2, chunk=8)
+    got, handles = run_engine(eng, prompts, sps)
+    assert eng.n_recompiles == 0
+    flags = [h.long_prompt for h in handles]
+    assert flags == [n > eng.prompt_pane for n in (40, 5) * 4]
+    eng.shutdown()
+    for out, p, s in zip(got, prompts, sps):
+        assert out == solo_tokens(params, cfg, p, s)
+
+
+def test_sp_composes_with_paged_int8(model):
+    """sp=2 + paged KV + int8 quant: long prompts land in the shared
+    page pool, outputs still match generate(), the ledger stays
+    byte-exact, and no pane copies happen (pages are copy-free)."""
+    cfg, params = model
+    rng = np.random.default_rng(2)
+    prompts = [rng.integers(0, cfg.vocab_size, (40,)).astype(np.int32)
+               for _ in range(3)]
+    sps = [SamplingParams(max_new_tokens=5, seed=i, ignore_eos=True)
+           for i in range(3)]
+    pol = KVCachePolicy(prefill_chunk=8, paged=True, page_tokens=8,
+                        kv_quant="int8")
+    eng = sp_engine(cfg, params, sp=2, kv_policy=pol)
+    got, handles = run_engine(eng, prompts, sps)
+    assert eng.n_recompiles == 0
+    assert all(h.long_prompt for h in handles)
+    eng.memory_ledger.observe(eng.n_ticks)
+    desc = eng.memory_ledger.describe()
+    assert desc["components"]["page_pool"] == cache_nbytes(eng.cache)
+    eng.shutdown()
+    # int8 KV is NOT bit-exact vs the fp oracle; parity is vs the
+    # unsharded engine under the SAME policy — sp must add zero error
+    ref = DecodeEngine(cfg, params, n_slots=2,
+                       kv_policy=KVCachePolicy(prefill_chunk=8, paged=True,
+                                               page_tokens=8,
+                                               kv_quant="int8"))
+    ref_out, _ = run_engine(ref, prompts, sps)
+    ref.shutdown()
+    assert got == ref_out
+
+
+# ---------------------------------------------------------------------------
+# telemetry
+# ---------------------------------------------------------------------------
+
+def test_warmup_event_reports_sp_geometry(model, sink):
+    """serve_warmup carries sp/prompt_pane_tokens/max_prompt on sp
+    engines (and omits them off-sp); request_done flags long prompts;
+    tick cadence books prefill under prefill_shard."""
+    cfg, params = model
+    rng = np.random.default_rng(3)
+    eng = sp_engine(cfg, params, sp=2, chunk=8, metrics_every=2)
+    prompts = [rng.integers(0, cfg.vocab_size, (40,)).astype(np.int32),
+               rng.integers(0, cfg.vocab_size, (5,)).astype(np.int32)]
+    sps = [SamplingParams(max_new_tokens=4, ignore_eos=True)] * 2
+    run_engine(eng, prompts, sps)
+    eng.shutdown()
+    rows = [json.loads(line) for line in open(sink)]
+    warm = [r for r in rows if r.get("event") == "serve_warmup"]
+    assert warm and warm[0]["sp"] == 2
+    assert warm[0]["prompt_pane_tokens"] == eng.prompt_pane
+    assert warm[0]["max_prompt"] == eng.max_prompt
+    done = [r for r in rows if r.get("event") == "request_done"]
+    assert sorted(bool(r.get("long_prompt")) for r in done) == [False, True]
+    ticks = [r for r in rows if r.get("type") == "metrics"
+             and "tick_prefill_shard_s" in r]
+    assert ticks and sum(r["tick_prefill_shard_s"] for r in ticks) > 0
+    # the plain prefill phase stays zero: sp engines book the chunk
+    # pump under prefill_shard exclusively
+    assert sum(r.get("tick_prefill_s", 0) for r in ticks) == 0
+
+
+def test_warmup_event_omits_sp_fields_off_sp(model, sink):
+    cfg, params = model
+    eng = DecodeEngine(cfg, params, n_slots=2,
+                       kv_policy=KVCachePolicy(prefill_chunk=8))
+    eng.warmup()
+    eng.shutdown()
+    rows = [json.loads(line) for line in open(sink)]
+    warm = [r for r in rows if r.get("event") == "serve_warmup"]
+    assert warm and "sp" not in warm[0]
+
+
+# ---------------------------------------------------------------------------
+# mesh-plan geometry
+# ---------------------------------------------------------------------------
+
+def test_serve_mesh_plan_sp_geometry():
+    plan = serve_mesh_plan(sp=2)
+    assert plan.mesh.shape == {"data": 1, "seq": 2, "model": 1}
+    assert plan.n_seq == 2 and plan.n_model == 1
+    plan2 = serve_mesh_plan(2, sp=2)
+    assert plan2.mesh.shape == {"data": 1, "seq": 2, "model": 2}
+    with pytest.raises(ValueError):
+        serve_mesh_plan(sp=0)
+
+
+def test_partition_serve_devices_sp():
+    from building_llm_from_scratch_tpu.parallel.sharding import (
+        partition_serve_devices,
+    )
+
+    slices = partition_serve_devices(2, 1, 2)
+    assert len(slices) == 2
+    assert all(len(s) == 2 for s in slices)
+    # disjoint when 2 replicas x (sp=2) = 4 <= 8 devices
+    ids = [d.id for s in slices for d in s]
+    assert len(set(ids)) == 4
+    with pytest.raises(ValueError, match="exceeds"):
+        partition_serve_devices(1, 4, 4)

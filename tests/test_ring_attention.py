@@ -322,3 +322,65 @@ def test_sp_inside_forward_matches_global_forward():
             check_vma=False))(params, jnp.asarray(toks)))
         np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5,
                                    err_msg=family)
+
+
+# ---------------------------------------------------------------------------
+# long-context tier additions (PR 20): odd per-shard pane sizes + bf16
+# parity — the shard-size/dtype corners the 32k pretrain config lands on
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("sp,T", [(2, 6), (4, 84), (2, 250)])
+def test_ring_odd_shard_sizes_match_oracle(sp, T):
+    """Per-shard panes that are odd or non-power-of-two (3, 21, 125
+    tokens/device) match the dense oracle — the ring schedule has no
+    hidden power-of-two or evenness assumption beyond T % sp == 0."""
+    plan = build_mesh_plan("dp", sp=sp)
+    q, k, v = _qkv(B=8 // sp, T=T)
+    want = causal_attention(q, k, v, impl="xla")
+    got = jax.jit(lambda a, b, c: ring_causal_attention(a, b, c, plan.mesh))(
+        q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.parametrize("sp", [2, 4])
+def test_ring_bf16_matches_oracle(sp):
+    """bf16 q/k/v through the ring: the fp32 online-softmax accumulator
+    keeps the result within bf16 resolution of the dense oracle, and the
+    output dtype stays bf16 (no silent fp32 widening into the residual
+    stream)."""
+    q, k, v = _qkv(B=8 // sp, T=128)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    plan = build_mesh_plan("dp", sp=sp)
+    want = causal_attention(qb, kb, vb, impl="xla")
+    got = jax.jit(lambda a, b, c: ring_causal_attention(a, b, c, plan.mesh))(
+        qb, kb, vb)
+    assert got.dtype == jnp.bfloat16
+    assert want.dtype == jnp.bfloat16
+    # the ring carries softmax weights in fp32 through the PV
+    # accumulation while the oracle casts them to bf16 first — the two
+    # agree to ~bf16 epsilon, not exactly (same bound _llama_cfg notes)
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32),
+                               atol=3e-2, rtol=3e-2)
+
+
+def test_ring_gradients_odd_shards():
+    """Gradients through the ring at an odd per-shard pane (21
+    tokens/device): the backward ppermute chain must handle the same
+    shard sizes the forward does."""
+    plan = build_mesh_plan("dp", sp=4)
+    q, k, v = _qkv(B=2, T=84)
+
+    def loss(fn, q, k, v):
+        return jnp.sum(fn(q, k, v) ** 2)
+
+    gw = jax.grad(lambda *a: loss(
+        lambda x, y, z: causal_attention(x, y, z, impl="xla"), *a),
+        argnums=(0, 1, 2))(q, k, v)
+    gr = jax.jit(jax.grad(lambda *a: loss(
+        lambda x, y, z: ring_causal_attention(x, y, z, plan.mesh), *a),
+        argnums=(0, 1, 2)))(q, k, v)
+    for a, b in zip(gr, gw):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4)
